@@ -1,0 +1,69 @@
+"""Headline benchmark: prints ONE JSON line.
+
+North-star config #2 (BASELINE.md): distributed matmul, split-0 × split-1. The reference
+benches ``a @ b`` at n=3000 f32 under MPI (``benchmarks/cb/linalg.py:44-56``); the
+reference repo publishes no absolute numbers (BASELINE.json ``published: {}``), so
+``vs_baseline`` reports achieved fraction of the chip's peak matmul throughput —
+a hardware-normalised stand-in until a reference wall-clock exists.
+
+Methodology: K chained matmuls inside ONE jitted program (the framework's compute path is
+XLA on mesh-sharded global arrays), timed around a final scalar readback —
+device-dispatch latency is excluded, as in the reference's perun wall-clock of a tight
+loop.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+
+    on_tpu = jax.default_backend() != "cpu"
+    n = 4096 if on_tpu else 1024
+    dtype = ht.bfloat16 if on_tpu else ht.float32
+    iters = 32
+
+    # distributed operands via the framework's factories (split-0 × split-1)
+    a = ht.array(jax.random.normal(jax.random.key(0), (n, n), dtype.jax_type()), split=0)
+    b = ht.array(jax.random.normal(jax.random.key(1), (n, n), dtype.jax_type()), split=1)
+
+    @jax.jit
+    def chained(a, b):
+        def body(i, c):
+            return (c @ b) * (1.0 / n)  # rescale to keep bf16 in range
+
+        return jax.lax.fori_loop(0, iters, body, a).sum()
+
+    # compile + warmup (first compile through the tunnel is slow)
+    float(chained(a.larray, b.larray))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(chained(a.larray, b.larray))
+        best = min(best, (time.perf_counter() - t0) / iters)
+
+    flops = 2 * n**3
+    ndev = len(jax.devices())
+    tflops = flops / best / 1e12 / ndev
+    # peak bf16 matmul throughput per chip: v5e ≈ 394 TFLOP/s (v5p ≈ 459); CPU: no target
+    peak = 394.0 if on_tpu else max(tflops, 1e-9)
+    print(
+        json.dumps(
+            {
+                "metric": f"matmul_{n}x{n}_{dtype.__name__}_split0x1_tflops_per_chip",
+                "value": round(tflops, 3),
+                "unit": "TFLOP/s",
+                "vs_baseline": round(tflops / peak, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""heat_tpu — a TPU-native distributed n-D tensor framework.
+
+A ground-up re-design of the capabilities of Heat (Helmholtz Analytics Toolkit,
+https://github.com/helmholtz-analytics/heat) for TPU: global ``jax.Array``s over a
+device mesh replace process-local torch tensors over MPI, and XLA SPMD replaces the
+hand-written collective choreography. Usage mirrors the reference::
+
+    import heat_tpu as ht
+    x = ht.arange(10, split=0)
+    x.sum()
+"""
+
+import jax as _jax
+
+# float64/complex128/int64 availability (the reference supports f64 via torch); the
+# *default* float stays float32 — factories pass explicit dtypes everywhere.
+_jax.config.update("jax_enable_x64", True)
+
+from .core import *
+from .core import __version__
+from . import core

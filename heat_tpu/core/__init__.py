@@ -1,0 +1,41 @@
+"""heat_tpu core: distributed n-D arrays over JAX/XLA (reference heat/core/__init__.py)."""
+
+from .communication import *
+from .devices import *
+from .types import *
+from .stride_tricks import *
+from .dndarray import *
+from .memory import *
+from .sanitation import *
+from .factories import *
+from .printing import *
+from .arithmetics import *
+from .rounding import *
+from .trigonometrics import *
+from .exponential import *
+from .relational import *
+from .logical import *
+from .complex_math import *
+from . import linalg
+from .linalg import *  # promoted to the flat namespace like the reference
+from .version import __version__
+
+from . import (
+    arithmetics,
+    communication,
+    complex_math,
+    devices,
+    dndarray,
+    exponential,
+    factories,
+    logical,
+    memory,
+    printing,
+    relational,
+    rounding,
+    sanitation,
+    stride_tricks,
+    trigonometrics,
+    types,
+    version,
+)

@@ -1,0 +1,214 @@
+"""Generic operation dispatch (reference heat/core/_operations.py:22-532).
+
+The reference's four wrappers hand-roll type promotion, broadcasting, operand
+redistribution and MPI reductions. Here the data is a global ``jax.Array``, so:
+
+- ``__binary_op`` (reference ``:22-227``): the "dominant operand defines the output split"
+  rule survives as *metadata*; the physical redistribution the reference performs via
+  ``sanitize_distribution`` is replaced by XLA's sharding propagation — the jnp call
+  simply computes, and the result is constrained to the chosen split.
+- ``__reduce_op`` (reference ``:404-532``): the local-partial-then-Allreduce dance becomes
+  one jnp reduction; XLA emits the all-reduce over the mesh axis when the reduction
+  crosses the split dimension. Neutral-element handling for empty shards (reference
+  ``:450-459``) is unnecessary — XLA reduces over the global value.
+- ``__cum_op`` (reference ``:230-328``): local cumop + Exscan + combine becomes one jnp
+  cumulative op; XLA lowers the cross-shard carry.
+- ``__local_op`` (reference ``:331``): elementwise jnp call, split unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import sanitation, types
+from .communication import get_comm
+from .devices import get_device
+from .dndarray import DNDarray
+from .stride_tricks import broadcast_shapes, sanitize_axis
+
+__all__ = ["binary_op", "local_op", "reduce_op", "cum_op"]
+
+Scalar = (int, float, bool, complex, np.number, np.bool_)
+
+
+def _ensure_dndarray(x, device=None, comm=None) -> DNDarray:
+    from . import factories
+
+    if isinstance(x, DNDarray):
+        return x
+    return factories.array(x, device=device, comm=comm)
+
+
+def _out_split_binary(out_shape: Tuple[int, ...], *operands: DNDarray) -> Optional[int]:
+    """Dominant-operand split rule (reference ``_operations.py:71-75``): a split operand
+    beats an unsplit one; a split on a non-broadcast dim beats a split on a broadcast dim;
+    the first operand beats the second."""
+    nd = len(out_shape)
+    best = None
+    for arr in operands:
+        if not isinstance(arr, DNDarray) or arr.split is None:
+            continue
+        s = arr.split + (nd - arr.ndim)
+        broadcasted = arr.gshape[arr.split] == 1 and out_shape[s] != 1
+        if not broadcasted:
+            return s
+        if best is None:
+            best = s
+    return best
+
+
+def binary_op(
+    operation: Callable,
+    t1,
+    t2,
+    out: Optional[DNDarray] = None,
+    where=None,
+    fn_kwargs: Optional[dict] = None,
+) -> DNDarray:
+    """Apply a binary jnp operation with Heat's split/type semantics
+    (reference ``__binary_op`` ``_operations.py:22``)."""
+    fn_kwargs = fn_kwargs or {}
+    if np.isscalar(t1) and np.isscalar(t2) and out is None and where is None:
+        res = operation(jnp.asarray(t1), jnp.asarray(t2), **fn_kwargs)
+        from . import factories
+
+        return factories.array(res)
+    comm = None
+    device = None
+    for t in (t1, t2):
+        if isinstance(t, DNDarray):
+            comm, device = t.comm, t.device
+            break
+    a = _ensure_dndarray(t1, device, comm)
+    b = _ensure_dndarray(t2, device, comm)
+
+    out_shape = broadcast_shapes(a.gshape, b.gshape)
+    out_split = _out_split_binary(out_shape, a, b)
+
+    # promote: scalars stay weakly typed so jnp's promotion matches numpy/heat
+    x1 = a.larray if not np.isscalar(t1) else t1
+    x2 = b.larray if not np.isscalar(t2) else t2
+    result = operation(x1, x2, **fn_kwargs)
+
+    if where is not None:
+        w = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
+        base = out.larray if out is not None else jnp.zeros(out_shape, result.dtype)
+        result = jnp.where(w, result, base)
+
+    use_comm = comm or get_comm()
+    if out is not None:
+        sanitation.sanitize_out(out, out_shape, out_split, device)
+        result = use_comm.shard(result.astype(out.dtype.jax_type()), out.split)
+        out.larray = result
+        return out
+    result = use_comm.shard(result, out_split)
+    return DNDarray(
+        result,
+        out_shape,
+        types.canonical_heat_type(result.dtype),
+        out_split,
+        device or get_device(),
+        use_comm,
+        True,
+    )
+
+
+def local_op(
+    operation: Callable, x: DNDarray, out: Optional[DNDarray] = None, no_cast: bool = False, **fn_kwargs
+) -> DNDarray:
+    """Elementwise operation, no communication (reference ``__local_op`` ``:331``)."""
+    sanitation.sanitize_in(x)
+    result = operation(x.larray, **fn_kwargs)
+    if out is not None:
+        sanitation.sanitize_out(out, x.gshape, x.split, x.device)
+        out.larray = x.comm.shard(result.astype(out.dtype.jax_type()), out.split)
+        return out
+    result = x.comm.shard(result, x.split)
+    return DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype), x.split, x.device, x.comm, x.balanced
+    )
+
+
+def _out_split_reduce(
+    x: DNDarray, axis: Optional[Union[int, Tuple[int, ...]]], keepdims: bool
+) -> Optional[int]:
+    """Split bookkeeping for reductions (reference ``_operations.py:492-501``)."""
+    if x.split is None:
+        return None
+    if axis is None:
+        return None
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    if x.split in axes:
+        return None
+    if keepdims:
+        return x.split
+    return x.split - sum(1 for ax in axes if ax < x.split)
+
+
+def reduce_op(
+    operation: Callable,
+    x: DNDarray,
+    axis: Optional[Union[int, Sequence[int]]] = None,
+    out: Optional[DNDarray] = None,
+    keepdims: bool = False,
+    **fn_kwargs,
+) -> DNDarray:
+    """Apply a reduction with Heat's split bookkeeping (reference ``__reduce_op`` ``:404``).
+
+    The reference's local-partial + ``Allreduce`` with a custom MPI op is replaced by a
+    single global jnp reduction; XLA inserts the cross-shard all-reduce when ``axis``
+    covers the split dimension.
+    """
+    sanitation.sanitize_in(x)
+    axis = sanitize_axis(x.gshape, axis)
+    out_split = _out_split_reduce(x, axis, keepdims)
+    result = operation(x.larray, axis=axis, keepdims=keepdims, **fn_kwargs)
+    out_shape = tuple(result.shape)
+    if out_split is not None and out_split >= len(out_shape):
+        out_split = None
+    if out is not None:
+        sanitation.sanitize_out(out, out_shape, out_split, x.device)
+        out.larray = x.comm.shard(result.astype(out.dtype.jax_type()), out.split)
+        return out
+    result = x.comm.shard(result, out_split)
+    return DNDarray(
+        result, out_shape, types.canonical_heat_type(result.dtype), out_split, x.device, x.comm, True
+    )
+
+
+def cum_op(
+    operation: Callable,
+    x: DNDarray,
+    axis: int,
+    out: Optional[DNDarray] = None,
+    dtype=None,
+    **fn_kwargs,
+) -> DNDarray:
+    """Cumulative operation along ``axis`` (reference ``__cum_op`` ``:230``): one jnp call;
+    XLA lowers the cross-shard prefix carry that the reference built from ``Exscan``."""
+    sanitation.sanitize_in(x)
+    axis = sanitize_axis(x.gshape, axis)
+    if axis is None:
+        raise NotImplementedError("cumulative operations require an explicit axis")
+    result = operation(x.larray, axis=axis, **fn_kwargs)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+    if out is not None:
+        sanitation.sanitize_out(out, x.gshape, x.split, x.device)
+        out.larray = x.comm.shard(result.astype(out.dtype.jax_type()), out.split)
+        return out
+    result = x.comm.shard(result, x.split)
+    return DNDarray(
+        result, x.gshape, types.canonical_heat_type(result.dtype), x.split, x.device, x.comm, x.balanced
+    )
+
+
+# Parity aliases matching the reference's private names (used by its op modules).
+__binary_op = binary_op
+__local_op = local_op
+__reduce_op = reduce_op
+__cum_op = cum_op

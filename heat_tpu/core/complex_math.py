@@ -1,0 +1,35 @@
+"""Complex number operations (reference heat/core/complex_math.py, 5 exports)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+
+
+def angle(x: DNDarray, deg: bool = False, out=None) -> DNDarray:
+    """Argument of the complex values (reference ``complex_math.py`` angle)."""
+    return _operations.local_op(jnp.angle, x, out, deg=deg)
+
+
+def conjugate(x: DNDarray, out=None) -> DNDarray:
+    """Complex conjugate (reference ``complex_math.py`` conjugate)."""
+    return _operations.local_op(jnp.conjugate, x, out)
+
+
+conj = conjugate
+
+
+def imag(x: DNDarray, out=None) -> DNDarray:
+    """Imaginary part (zero array for real inputs)."""
+    return _operations.local_op(jnp.imag, x, out)
+
+
+def real(x: DNDarray, out=None) -> DNDarray:
+    """Real part (identity for real inputs)."""
+    if isinstance(x, DNDarray) and not types.heat_type_is_complexfloating(x.dtype):
+        return x
+    return _operations.local_op(jnp.real, x, out)

@@ -1,0 +1,102 @@
+"""Device registry (reference heat/core/devices.py:14-181, re-targeted at TPU).
+
+The reference maps Heat devices onto torch devices with a round-robin GPU→rank rule
+(``devices.py:114-118``). Here a :class:`Device` names a JAX platform; actual placement of
+distributed arrays is governed by the mesh in :mod:`heat_tpu.core.communication`, so the
+device object is a label + default-platform selector rather than an address.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+
+__all__ = ["Device", "cpu", "tpu", "gpu", "get_device", "use_device", "sanitize_device"]
+
+
+class Device:
+    """Implements a compute device. ``device_type`` is a JAX platform name
+    (``"cpu"``, ``"tpu"``, ``"gpu"``); ``device_id`` selects among local devices.
+
+    Mirrors reference ``heat/core/devices.py:17-94``.
+    """
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.__device_type = device_type.strip().lower()
+        self.__device_id = int(device_id)
+
+    @property
+    def device_type(self) -> str:
+        return self.__device_type
+
+    @property
+    def device_id(self) -> int:
+        return self.__device_id
+
+    @property
+    def jax_device(self) -> Optional[jax.Device]:
+        """The concrete ``jax.Device`` this label resolves to, or None if absent."""
+        try:
+            devs = jax.devices(self.__device_type)
+        except RuntimeError:
+            return None
+        if not devs:
+            return None
+        return devs[self.__device_id % len(devs)]
+
+    def __repr__(self) -> str:
+        return f"device({str(self)!r})"
+
+    def __str__(self) -> str:
+        return f"{self.__device_type}:{self.__device_id}"
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Device):
+            return self.device_type == other.device_type and self.device_id == other.device_id
+        if isinstance(other, str):
+            return str(self) == other or self.device_type == other
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(str(self))
+
+
+cpu = Device("cpu")
+"""The host CPU device (reference ``devices.py:95``)."""
+
+# TPU/GPU singletons exist whenever the platform is present; on this build the default
+# accelerator platform is whatever jax initialised with (axon TPU in production).
+_default_platform = jax.default_backend()
+
+tpu = Device("tpu") if _default_platform not in ("cpu", "gpu") else Device(_default_platform)
+gpu = tpu  # alias for source compatibility with reference code written for ``ht.gpu``
+
+__default_device = Device(_default_platform)
+
+
+def get_device() -> Device:
+    """Return the current default device (reference ``devices.py:160``)."""
+    return __default_device
+
+
+def use_device(device: Optional[Union[str, Device]] = None) -> None:
+    """Set the default device (reference ``devices.py:171``)."""
+    global __default_device
+    __default_device = sanitize_device(device)
+
+
+def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
+    """Validate ``device`` or fall back to the default (reference ``devices.py:130``)."""
+    if device is None:
+        return get_device()
+    if isinstance(device, Device):
+        return device
+    if isinstance(device, str):
+        dev = device.strip().lower()
+        if ":" in dev:
+            kind, _, idx = dev.partition(":")
+            return Device(kind, int(idx))
+        if dev in ("cpu", "tpu", "gpu", "axon"):
+            return Device(dev)
+    raise ValueError(f"Unknown device, must be 'cpu', 'tpu' or 'gpu', got {device!r}")

@@ -1,0 +1,950 @@
+"""DNDarray: a distributed n-D array as a thin wrapper over a *global* ``jax.Array``.
+
+Reference: ``heat/core/dndarray.py:39-1940``. There, a DNDarray is a process-local
+``torch.Tensor`` plus metadata (global shape, ``split`` axis, comm), and every method
+hand-rolls the MPI choreography. Here the payload **is already global**: a ``jax.Array``
+laid out over the communicator's device mesh with ``NamedSharding``; ``split=k`` means
+mesh axis ``'d'`` is mapped onto array dimension ``k``, ``split=None`` means fully
+replicated. Distribution verbs therefore collapse:
+
+- ``resplit_`` (reference ``:1407-1536``, tile-wise Isend/Irecv) → one ``device_put`` /
+  sharding constraint; XLA emits the all-to-all.
+- ``balance_``/``redistribute_`` (reference ``:501,:1208``) → no-ops on data (XLA shard
+  layouts are canonical ceil-division chunks by construction); they only refresh metadata.
+- halo exchange (reference ``get_halo :387-455``) → slicing the global array; XLA inserts
+  the neighbour communication (collective-permute on the ICI torus).
+- ``__getitem__``/``__setitem__`` (reference ``:828,:1538``, a 700-line distributed
+  indexing engine over a meta-tensor proxy) → ``jax.numpy`` indexing on the global value
+  plus split bookkeeping.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import types
+from .communication import Communication, MeshCommunication, get_comm
+from .devices import Device, get_device
+from .stride_tricks import sanitize_axis
+
+__all__ = ["DNDarray", "LocalIndex"]
+
+Scalar = Union[int, float, bool, complex]
+
+
+class LocalIndex:
+    """Marker for indexing the process-local data (reference ``dndarray.py:23``)."""
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def __getitem__(self, key):
+        return LocalIndex((self.obj, key))
+
+
+class DNDarray:
+    """Distributed N-Dimensional array (reference ``dndarray.py:39``).
+
+    Parameters
+    ----------
+    array : jax.Array
+        The **global** array value, sharded according to ``split``.
+    gshape : tuple of int
+        Global shape (equals ``array.shape``; kept explicitly for parity and for
+        zero-size bookkeeping).
+    dtype : datatype
+        Heat datatype class.
+    split : int or None
+        Dimension carrying the mesh axis, or None for replicated.
+    device : Device
+        Device label.
+    comm : Communication
+        The mesh communicator.
+    balanced : bool
+        Whether shards follow the canonical chunking (always True for arrays produced by
+        this framework; kept for API parity with reference ``dndarray.py:166``).
+    """
+
+    def __init__(
+        self,
+        array: jax.Array,
+        gshape: Tuple[int, ...],
+        dtype: type,
+        split: Optional[int],
+        device: Device,
+        comm: Communication,
+        balanced: Optional[bool] = True,
+    ):
+        self.__array = array
+        self.__gshape = tuple(int(s) for s in gshape)
+        self.__dtype = dtype
+        self.__split = split
+        self.__device = device
+        self.__comm = comm
+        self.__balanced = balanced
+        self.__halo_next: Optional[jax.Array] = None
+        self.__halo_prev: Optional[jax.Array] = None
+
+    # ------------------------------------------------------------------ properties
+    @property
+    def larray(self) -> jax.Array:
+        """The underlying ``jax.Array``.
+
+        In the reference this is the process-local torch tensor (``dndarray.py:131``); in
+        single-controller JAX the addressable value *is* the global array (per-shard views
+        are exposed via :attr:`lshards`). Multi-controller processes see their
+        addressable shards through the same object.
+        """
+        return self.__array
+
+    @larray.setter
+    def larray(self, array: jax.Array) -> None:
+        """Rebind the payload (reference setter ``dndarray.py:146-168``)."""
+        if not isinstance(array, jax.Array):
+            raise TypeError(f"larray must be a jax.Array, got {type(array)}")
+        self.__array = array
+        self.__gshape = tuple(array.shape)
+        self.__dtype = types.canonical_heat_type(array.dtype)
+
+    @property
+    def garray(self) -> jax.Array:
+        """Alias emphasising the global nature of the payload."""
+        return self.__array
+
+    @property
+    def lshards(self) -> List[jax.Array]:
+        """Per-device local shard values addressable from this process."""
+        return [s.data for s in self.__array.addressable_shards]
+
+    @property
+    def balanced(self) -> Optional[bool]:
+        return self.__balanced
+
+    @property
+    def comm(self) -> Communication:
+        return self.__comm
+
+    @comm.setter
+    def comm(self, comm: Communication) -> None:
+        if not isinstance(comm, Communication):
+            raise TypeError(f"comm must be a Communication, got {type(comm)}")
+        self.__comm = comm
+
+    @property
+    def device(self) -> Device:
+        return self.__device
+
+    @device.setter
+    def device(self, device: Device) -> None:
+        self.__device = device
+
+    @property
+    def dtype(self):
+        return self.__dtype
+
+    @property
+    def gshape(self) -> Tuple[int, ...]:
+        return self.__gshape
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.__gshape
+
+    @property
+    def ndim(self) -> int:
+        return len(self.__gshape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.__gshape)) if self.__gshape else 1
+
+    gnumel = size
+
+    @property
+    def lnumel(self) -> int:
+        return int(np.prod(self.lshape)) if self.lshape else 1
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * np.dtype(self.__dtype.jax_type()).itemsize
+
+    gnbytes = nbytes
+
+    @property
+    def lnbytes(self) -> int:
+        return self.lnumel * np.dtype(self.__dtype.jax_type()).itemsize
+
+    @property
+    def lshape(self) -> Tuple[int, ...]:
+        """This rank's chunk shape under the canonical chunking (reference ``:117``)."""
+        _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split)
+        return lshape
+
+    @property
+    def split(self) -> Optional[int]:
+        return self.__split
+
+    @property
+    def stride(self) -> Tuple[int, ...]:
+        """Row-major strides in elements (reference returns torch strides)."""
+        strides = []
+        acc = 1
+        for s in reversed(self.__gshape):
+            strides.append(acc)
+            acc *= max(s, 1)
+        return tuple(reversed(strides))
+
+    @property
+    def strides(self) -> Tuple[int, ...]:
+        itemsize = np.dtype(self.__dtype.jax_type()).itemsize
+        return tuple(s * itemsize for s in self.stride)
+
+    @property
+    def T(self) -> "DNDarray":
+        from .linalg import transpose
+
+        return transpose(self, None)
+
+    @property
+    def real(self) -> "DNDarray":
+        from .complex_math import real
+
+        return real(self)
+
+    @property
+    def imag(self) -> "DNDarray":
+        from .complex_math import imag
+
+        return imag(self)
+
+    @property
+    def lloc(self) -> LocalIndex:
+        return LocalIndex(self.__array)
+
+    @property
+    def __partitioned__(self) -> dict:
+        """Partition interface for cross-framework interop (reference ``dndarray.py:680``)."""
+        return self.create_partition_interface()
+
+    # ------------------------------------------------------------------ distribution
+    def lshape_map(self, force_check: bool = False) -> "DNDarray":
+        """(size, ndim) map of shard shapes (reference ``dndarray.py:304,647``)."""
+        from . import factories
+
+        lmap = self.__comm.lshape_map(self.__gshape, self.__split)
+        return factories.array(lmap, dtype=types.int64, split=None, device=self.__device, comm=self.__comm)
+
+    def create_lshape_map(self, force_check: bool = False) -> "DNDarray":
+        return self.lshape_map(force_check)
+
+    def is_balanced(self, force_check: bool = False) -> bool:
+        """Canonical XLA layouts are balanced by construction (reference ``:466``)."""
+        return True
+
+    def is_distributed(self) -> bool:
+        """True if data lives on more than one device and is not replicated
+        (reference ``dndarray.py:484``)."""
+        return self.__split is not None and self.__comm.size > 1
+
+    def balance_(self) -> "DNDarray":
+        """Rebalance in place (reference ``dndarray.py:501``). XLA shard layouts are always
+        the canonical ceil-division chunks, so this only normalises metadata."""
+        self.__balanced = True
+        return self
+
+    def redistribute_(self, lshape_map=None, target_map=None) -> "DNDarray":
+        """Redistribute to a target lshape map (reference ``dndarray.py:1208-1358``).
+
+        Arbitrary target maps are intentionally unsupported: XLA owns the physical layout
+        and always uses canonical chunks, so the only meaningful redistribution is a
+        rebalance, which is the identity here. Raises if a genuinely non-canonical target
+        is requested.
+        """
+        if target_map is not None:
+            tmap = np.asarray(
+                target_map.larray if isinstance(target_map, DNDarray) else target_map
+            )
+            canonical = self.__comm.lshape_map(self.__gshape, self.__split)
+            if not np.array_equal(tmap, canonical):
+                raise NotImplementedError(
+                    "non-canonical shard layouts are owned by XLA on TPU; "
+                    "arbitrary target lshape maps are not representable"
+                )
+        self.__balanced = True
+        return self
+
+    def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
+        """In-place redistribution along a new split axis (reference ``dndarray.py:1407``).
+
+        split→None ≙ Allgatherv, None→split ≙ local slice, split→split ≙ all-to-all — all
+        emitted by XLA from a single re-sharding.
+        """
+        axis = sanitize_axis(self.__gshape, axis)
+        if axis == self.__split:
+            return self
+        self.__array = self.__comm.shard(self.__array, axis)
+        self.__split = axis
+        self.__balanced = True
+        return self
+
+    def resplit(self, axis: Optional[int] = None) -> "DNDarray":
+        """Out-of-place resplit (reference ``manipulations.py:3480``)."""
+        axis = sanitize_axis(self.__gshape, axis)
+        new = self.__comm.shard(self.__array, axis)
+        return DNDarray(new, self.__gshape, self.__dtype, axis, self.__device, self.__comm, True)
+
+    def collect_(self, target_rank: int = 0) -> "DNDarray":
+        """Gather the full array (reference ``dndarray.py:573``): becomes split=None."""
+        self.resplit_(None)
+        return self
+
+    # ------------------------------------------------------------------ halos
+    def get_halo(self, halo_size: int, prev: bool = True, next: bool = True) -> None:
+        """Fetch halo regions of the neighbouring shards (reference ``dndarray.py:387-455``).
+
+        With a global array, a halo is just a slice at this rank's chunk boundary; XLA
+        turns the cross-shard reads into collective-permutes on the ICI torus.
+        """
+        if not isinstance(halo_size, int) or halo_size < 0:
+            raise (TypeError if not isinstance(halo_size, int) else ValueError)(
+                f"halo_size needs to be a non-negative Python int, got {halo_size}"
+            )
+        if self.__split is None or not self.is_distributed():
+            self.__halo_prev = None
+            self.__halo_next = None
+            return
+        start, lshape, _ = self.__comm.chunk(self.__gshape, self.__split)
+        end = start + lshape[self.__split]
+        ax = self.__split
+
+        def _slab(a, b):
+            idx = tuple(
+                slice(a, b) if i == ax else slice(None) for i in range(self.ndim)
+            )
+            return self.__array[idx]
+
+        self.__halo_prev = _slab(max(start - halo_size, 0), start) if (prev and start > 0) else None
+        self.__halo_next = (
+            _slab(end, min(end + halo_size, self.__gshape[ax])) if (next and end < self.__gshape[ax]) else None
+        )
+
+    @property
+    def halo_prev(self) -> Optional[jax.Array]:
+        return self.__halo_prev
+
+    @property
+    def halo_next(self) -> Optional[jax.Array]:
+        return self.__halo_next
+
+    @property
+    def array_with_halos(self) -> jax.Array:
+        """Local chunk with fetched halos attached (reference ``dndarray.py:360``)."""
+        _, _, slices = self.__comm.chunk(self.__gshape, self.__split)
+        local = self.__array[slices] if self.__split is not None else self.__array
+        parts = [p for p in (self.__halo_prev, local, self.__halo_next) if p is not None]
+        if len(parts) == 1:
+            return parts[0]
+        return jnp.concatenate(parts, axis=self.__split or 0)
+
+    # ------------------------------------------------------------------ conversion
+    def astype(self, dtype, copy: bool = True) -> "DNDarray":
+        """Cast to a new datatype (reference ``dndarray.py:222``)."""
+        dtype = types.canonical_heat_type(dtype)
+        casted = self.__array.astype(dtype.jax_type())
+        casted = self.__comm.shard(casted, self.__split)
+        if copy:
+            return DNDarray(casted, self.__gshape, dtype, self.__split, self.__device, self.__comm, self.__balanced)
+        self.__array = casted
+        self.__dtype = dtype
+        return self
+
+    def item(self) -> Scalar:
+        """The single element as a Python scalar (reference ``dndarray.py:1144``)."""
+        if self.size != 1:
+            raise ValueError("only one-element DNDarrays can be converted to Python scalars")
+        return self.__array.reshape(()).item()
+
+    def numpy(self) -> np.ndarray:
+        """Gather into a numpy array (reference ``dndarray.py:1169``)."""
+        return np.asarray(self.__array)
+
+    def tolist(self, keepsplit: bool = False) -> list:
+        """Nested Python lists (reference ``dndarray.py:1861``)."""
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None) -> np.ndarray:
+        out = self.numpy()
+        return out.astype(dtype) if dtype is not None else out
+
+    def cpu(self) -> "DNDarray":
+        """Move to host (reference ``dndarray.py:300``)."""
+        from . import devices, factories
+
+        arr = np.asarray(self.__array)
+        return factories.array(arr, dtype=self.__dtype, split=self.__split, device=devices.cpu, comm=self.__comm)
+
+    def create_partition_interface(self, no_data: bool = False) -> dict:
+        """``__partitioned__`` protocol dict (reference ``dndarray.py:680``)."""
+        lmap = self.__comm.lshape_map(self.__gshape, self.__split)
+        partitions = {}
+        for r in range(self.__comm.size):
+            start, lshape, slices = self.__comm.chunk(self.__gshape, self.__split, rank=r)
+            pos = tuple(0 if i != (self.__split or 0) else r for i in range(self.ndim)) if self.__split is not None else (0,) * self.ndim
+            partitions[pos] = {
+                "start": tuple(sl.start or 0 for sl in slices),
+                "shape": tuple(lshape),
+                "data": None if no_data else self.__array[slices],
+                "location": [r],
+                "dtype": np.dtype(self.__dtype.jax_type()),
+            }
+        grid = [1] * self.ndim
+        if self.__split is not None:
+            grid[self.__split] = self.__comm.size
+        return {
+            "shape": self.__gshape,
+            "partition_tiling": tuple(grid),
+            "partitions": partitions,
+            "locals": [tuple(0 if i != (self.__split or 0) else self.__comm.rank for i in range(self.ndim)) if self.__split is not None else (0,) * self.ndim],
+            "get": lambda x: np.asarray(x),
+        }
+
+    # ------------------------------------------------------------------ fills
+    def fill_diagonal(self, value: Scalar) -> "DNDarray":
+        """Fill the main diagonal in place (reference ``dndarray.py:744``)."""
+        if self.ndim != 2:
+            raise ValueError("fill_diagonal requires a 2-D DNDarray")
+        n = min(self.__gshape)
+        idx = jnp.arange(n)
+        new = self.__array.at[idx, idx].set(jnp.asarray(value, dtype=self.__array.dtype))
+        self.__array = self.__comm.shard(new, self.__split)
+        return self
+
+    # ------------------------------------------------------------------ indexing
+    def _index_split(self, key) -> Optional[int]:
+        """Split bookkeeping for basic indexing: how the split axis survives ``key``."""
+        if self.__split is None:
+            return None
+        if not isinstance(key, tuple):
+            key = (key,)
+        # expand ellipsis
+        if any(k is Ellipsis for k in key):
+            n_explicit = sum(1 for k in key if k is not Ellipsis and k is not None)
+            expanded = []
+            for k in key:
+                if k is Ellipsis:
+                    expanded.extend([slice(None)] * (self.ndim - n_explicit))
+                else:
+                    expanded.append(k)
+            key = tuple(expanded)
+        dim = 0  # input dim cursor
+        out_dim = 0  # output dim cursor
+        adv_seen = False
+        for k in key:
+            if k is None:
+                out_dim += 1
+                continue
+            if dim == self.__split:
+                if isinstance(k, slice):
+                    return out_dim
+                return None  # integer/advanced index consumed the split dim
+            if isinstance(k, (int, np.integer)):
+                dim += 1
+            elif isinstance(k, slice):
+                dim += 1
+                out_dim += 1
+            else:  # advanced index (array-like / bool mask)
+                adv = np.ndim(np.asarray(k) if not isinstance(k, DNDarray) else k.numpy())
+                if isinstance(k, DNDarray) and k.dtype is types.bool or (
+                    not isinstance(k, DNDarray) and np.asarray(k).dtype == np.bool_
+                ):
+                    dim += adv
+                else:
+                    dim += 1
+                if not adv_seen:
+                    out_dim += 1
+                    adv_seen = True
+        if dim <= self.__split:
+            # remaining dims are untouched
+            return out_dim + (self.__split - dim)
+        return None
+
+    def __getitem__(self, key) -> "DNDarray":
+        """Global indexing (reference ``dndarray.py:828-1086``)."""
+        from . import factories
+
+        new_split = self._index_split(key)
+        jkey = _jaxify_key(key)
+        result = self.__array[jkey]
+        if result.ndim == 0:
+            return factories.array(result, dtype=self.__dtype, device=self.__device, comm=self.__comm)
+        if new_split is not None and new_split >= result.ndim:
+            new_split = None
+        result = self.__comm.shard(result, new_split)
+        return DNDarray(
+            result, tuple(result.shape), self.__dtype, new_split, self.__device, self.__comm, True
+        )
+
+    def __setitem__(self, key, value) -> None:
+        """Global assignment (reference ``dndarray.py:1538``)."""
+        jkey = _jaxify_key(key)
+        if isinstance(value, DNDarray):
+            value = value.larray
+        value = jnp.asarray(value, dtype=self.__array.dtype)
+        new = self.__array.at[jkey].set(value)
+        self.__array = self.__comm.shard(new, self.__split)
+
+    def __iter__(self):
+        for i in range(self.__gshape[0] if self.ndim else 0):
+            yield self[i]
+
+    def __len__(self) -> int:
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.__gshape[0]
+
+    # ------------------------------------------------------------------ scalar casts
+    def __bool__(self) -> bool:
+        return bool(self.item())
+
+    def __int__(self) -> int:
+        return int(self.item())
+
+    def __float__(self) -> float:
+        return float(self.item())
+
+    def __complex__(self) -> complex:
+        return complex(self.item())
+
+    def __index__(self) -> int:
+        return int(self.item())
+
+    # ------------------------------------------------------------------ printing
+    def __repr__(self) -> str:
+        from . import printing
+
+        return printing.__str__(self)
+
+    __str__ = __repr__
+
+    # ------------------------------------------------------------------ arithmetic dunders
+    # (bound to the ops modules at import time by heat_tpu/__init__.py, mirroring the
+    # reference's late binding in heat/core/arithmetics.py etc.)
+    def __add__(self, other):
+        from . import arithmetics
+
+        return arithmetics.add(self, other)
+
+    def __radd__(self, other):
+        from . import arithmetics
+
+        return arithmetics.add(other, self)
+
+    def __iadd__(self, other):
+        from . import arithmetics
+
+        res = arithmetics.add(self, other)
+        self._rebind(res)
+        return self
+
+    def __sub__(self, other):
+        from . import arithmetics
+
+        return arithmetics.sub(self, other)
+
+    def __rsub__(self, other):
+        from . import arithmetics
+
+        return arithmetics.sub(other, self)
+
+    def __isub__(self, other):
+        from . import arithmetics
+
+        res = arithmetics.sub(self, other)
+        self._rebind(res)
+        return self
+
+    def __mul__(self, other):
+        from . import arithmetics
+
+        return arithmetics.mul(self, other)
+
+    def __rmul__(self, other):
+        from . import arithmetics
+
+        return arithmetics.mul(other, self)
+
+    def __imul__(self, other):
+        from . import arithmetics
+
+        res = arithmetics.mul(self, other)
+        self._rebind(res)
+        return self
+
+    def __truediv__(self, other):
+        from . import arithmetics
+
+        return arithmetics.div(self, other)
+
+    def __rtruediv__(self, other):
+        from . import arithmetics
+
+        return arithmetics.div(other, self)
+
+    def __itruediv__(self, other):
+        from . import arithmetics
+
+        res = arithmetics.div(self, other)
+        self._rebind(res)
+        return self
+
+    def __floordiv__(self, other):
+        from . import arithmetics
+
+        return arithmetics.floordiv(self, other)
+
+    def __rfloordiv__(self, other):
+        from . import arithmetics
+
+        return arithmetics.floordiv(other, self)
+
+    def __mod__(self, other):
+        from . import arithmetics
+
+        return arithmetics.mod(self, other)
+
+    def __rmod__(self, other):
+        from . import arithmetics
+
+        return arithmetics.mod(other, self)
+
+    def __pow__(self, other):
+        from . import arithmetics
+
+        return arithmetics.pow(self, other)
+
+    def __rpow__(self, other):
+        from . import arithmetics
+
+        return arithmetics.pow(other, self)
+
+    def __divmod__(self, other):
+        from . import arithmetics
+
+        return arithmetics.divmod(self, other)
+
+    def __matmul__(self, other):
+        from .linalg import matmul
+
+        return matmul(self, other)
+
+    def __neg__(self):
+        from . import arithmetics
+
+        return arithmetics.neg(self)
+
+    def __pos__(self):
+        from . import arithmetics
+
+        return arithmetics.pos(self)
+
+    def __abs__(self):
+        from . import rounding
+
+        return rounding.abs(self)
+
+    def __invert__(self):
+        from . import arithmetics
+
+        return arithmetics.invert(self)
+
+    def __lshift__(self, other):
+        from . import arithmetics
+
+        return arithmetics.left_shift(self, other)
+
+    def __rshift__(self, other):
+        from . import arithmetics
+
+        return arithmetics.right_shift(self, other)
+
+    def __and__(self, other):
+        from . import arithmetics
+
+        return arithmetics.bitwise_and(self, other)
+
+    def __or__(self, other):
+        from . import arithmetics
+
+        return arithmetics.bitwise_or(self, other)
+
+    def __xor__(self, other):
+        from . import arithmetics
+
+        return arithmetics.bitwise_xor(self, other)
+
+    # comparisons
+    def __eq__(self, other):
+        from . import relational
+
+        return relational.eq(self, other)
+
+    def __ne__(self, other):
+        from . import relational
+
+        return relational.ne(self, other)
+
+    def __lt__(self, other):
+        from . import relational
+
+        return relational.lt(self, other)
+
+    def __le__(self, other):
+        from . import relational
+
+        return relational.le(self, other)
+
+    def __gt__(self, other):
+        from . import relational
+
+        return relational.gt(self, other)
+
+    def __ge__(self, other):
+        from . import relational
+
+        return relational.ge(self, other)
+
+    __hash__ = None  # mutable container, like the reference
+
+    # ------------------------------------------------------------------ method aliases
+    # NumPy-style methods delegating to the functional API (reference defines these across
+    # the op modules and attaches them to DNDarray).
+    def _rebind(self, other: "DNDarray") -> None:
+        self.__array = other.larray
+        self.__gshape = other.gshape
+        self.__dtype = other.dtype
+        self.__split = other.split
+        self.__balanced = other.balanced
+
+    def abs(self, out=None, dtype=None):
+        from . import rounding
+
+        return rounding.abs(self, out, dtype)
+
+    def all(self, axis=None, out=None, keepdims=False):
+        from . import logical
+
+        return logical.all(self, axis, out, keepdims)
+
+    def any(self, axis=None, out=None, keepdims=False):
+        from . import logical
+
+        return logical.any(self, axis, out, keepdims)
+
+    def argmax(self, axis=None, out=None, **kwargs):
+        from . import statistics
+
+        return statistics.argmax(self, axis, out, **kwargs)
+
+    def argmin(self, axis=None, out=None, **kwargs):
+        from . import statistics
+
+        return statistics.argmin(self, axis, out, **kwargs)
+
+    def mean(self, axis=None):
+        from . import statistics
+
+        return statistics.mean(self, axis)
+
+    def median(self, axis=None, keepdims=False):
+        from . import statistics
+
+        return statistics.median(self, axis, keepdims=keepdims)
+
+    def std(self, axis=None, ddof=0, **kwargs):
+        from . import statistics
+
+        return statistics.std(self, axis, ddof=ddof, **kwargs)
+
+    def var(self, axis=None, ddof=0, **kwargs):
+        from . import statistics
+
+        return statistics.var(self, axis, ddof=ddof, **kwargs)
+
+    def max(self, axis=None, out=None, keepdims=None):
+        from . import statistics
+
+        return statistics.max(self, axis, out, keepdims)
+
+    def min(self, axis=None, out=None, keepdims=None):
+        from . import statistics
+
+        return statistics.min(self, axis, out, keepdims)
+
+    def sum(self, axis=None, out=None, keepdims=False):
+        from . import arithmetics
+
+        return arithmetics.sum(self, axis, out, keepdims)
+
+    def prod(self, axis=None, out=None, keepdims=False):
+        from . import arithmetics
+
+        return arithmetics.prod(self, axis, out, keepdims)
+
+    def cumsum(self, axis, out=None):
+        from . import arithmetics
+
+        return arithmetics.cumsum(self, axis, out)
+
+    def cumprod(self, axis, out=None):
+        from . import arithmetics
+
+        return arithmetics.cumprod(self, axis, out)
+
+    def reshape(self, *shape, new_split=None, **kwargs):
+        from . import manipulations
+
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return manipulations.reshape(self, shape, new_split=new_split, **kwargs)
+
+    def flatten(self):
+        from . import manipulations
+
+        return manipulations.flatten(self)
+
+    def ravel(self):
+        from . import manipulations
+
+        return manipulations.ravel(self)
+
+    def squeeze(self, axis=None):
+        from . import manipulations
+
+        return manipulations.squeeze(self, axis)
+
+    def expand_dims(self, axis):
+        from . import manipulations
+
+        return manipulations.expand_dims(self, axis)
+
+    def transpose(self, axes=None):
+        from .linalg import transpose
+
+        return transpose(self, axes)
+
+    def tril(self, k=0):
+        from .linalg import tril
+
+        return tril(self, k)
+
+    def triu(self, k=0):
+        from .linalg import triu
+
+        return triu(self, k)
+
+    def flip(self, axis=None):
+        from . import manipulations
+
+        return manipulations.flip(self, axis)
+
+    def roll(self, shift, axis=None):
+        from . import manipulations
+
+        return manipulations.roll(self, shift, axis)
+
+    def nonzero(self):
+        from . import indexing
+
+        return indexing.nonzero(self)
+
+    def unique(self, sorted=False, return_inverse=False, axis=None):
+        from . import manipulations
+
+        return manipulations.unique(self, sorted, return_inverse, axis)
+
+    def round(self, decimals=0, out=None, dtype=None):
+        from . import rounding
+
+        return rounding.round(self, decimals, out, dtype)
+
+    def floor(self, out=None):
+        from . import rounding
+
+        return rounding.floor(self, out)
+
+    def ceil(self, out=None):
+        from . import rounding
+
+        return rounding.ceil(self, out)
+
+    def trunc(self, out=None):
+        from . import rounding
+
+        return rounding.trunc(self, out)
+
+    def clip(self, min=None, max=None, out=None):
+        from . import rounding
+
+        return rounding.clip(self, min, max, out)
+
+    def copy(self):
+        from . import memory
+
+        return memory.copy(self)
+
+    def exp(self, out=None):
+        from . import exponential
+
+        return exponential.exp(self, out)
+
+    def log(self, out=None):
+        from . import exponential
+
+        return exponential.log(self, out)
+
+    def sqrt(self, out=None):
+        from . import exponential
+
+        return exponential.sqrt(self, out)
+
+    def sin(self, out=None):
+        from . import trigonometrics
+
+        return trigonometrics.sin(self, out)
+
+    def cos(self, out=None):
+        from . import trigonometrics
+
+        return trigonometrics.cos(self, out)
+
+    def tanh(self, out=None):
+        from . import trigonometrics
+
+        return trigonometrics.tanh(self, out)
+
+    def isclose(self, other, rtol=1e-05, atol=1e-08, equal_nan=False):
+        from . import logical
+
+        return logical.isclose(self, other, rtol, atol, equal_nan)
+
+    def tile(self, reps):
+        from . import manipulations
+
+        return manipulations.tile(self, reps)
+
+
+def _jaxify_key(key):
+    """Convert DNDarray / numpy members of an index expression to jax values."""
+    if isinstance(key, DNDarray):
+        return key.larray
+    if isinstance(key, tuple):
+        return tuple(_jaxify_key(k) for k in key)
+    if isinstance(key, list):
+        return jnp.asarray(np.asarray(key))
+    return key

@@ -1,0 +1,6 @@
+"""Distributed linear algebra (reference heat/core/linalg/)."""
+
+from .basics import *
+from . import basics
+
+__all__ = list(basics.__all__)

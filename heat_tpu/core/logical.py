@@ -1,0 +1,94 @@
+"""Logical operations (reference heat/core/logical.py, 557 LoC, 14 exports)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = [
+    "all",
+    "allclose",
+    "any",
+    "isclose",
+    "isfinite",
+    "isinf",
+    "isnan",
+    "isneginf",
+    "isposinf",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "signbit",
+]
+
+
+def all(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+    """Whether all elements evaluate True (reference ``logical.py`` all → ``__reduce_op``
+    with ``MPI.LAND``; here a jnp.all whose cross-shard and-reduce XLA emits)."""
+    return _operations.reduce_op(jnp.all, x, axis, out, keepdims)
+
+
+def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
+    """Collective scalar closeness verdict (reference ``logical.py:109``)."""
+    from . import factories
+
+    a = x if isinstance(x, DNDarray) else factories.array(x)
+    b = y if isinstance(y, DNDarray) else factories.array(y)
+    return bool(jnp.allclose(a.larray, b.larray, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def any(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+    """Whether any element evaluates True (reference ``logical.py`` any, ``MPI.LOR``)."""
+    return _operations.reduce_op(jnp.any, x, axis, out, keepdims)
+
+
+def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:
+    """Element-wise closeness (reference ``logical.py:229``)."""
+    return _operations.binary_op(
+        jnp.isclose, x, y, fn_kwargs=dict(rtol=rtol, atol=atol, equal_nan=equal_nan)
+    )
+
+
+def isfinite(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.isfinite, x, out)
+
+
+def isinf(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.isinf, x, out)
+
+
+def isnan(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.isnan, x, out)
+
+
+def isneginf(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.isneginf, x, out)
+
+
+def isposinf(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.isposinf, x, out)
+
+
+def logical_and(t1, t2) -> DNDarray:
+    return _operations.binary_op(jnp.logical_and, t1, t2)
+
+
+def logical_not(t, out=None) -> DNDarray:
+    return _operations.local_op(jnp.logical_not, t, out)
+
+
+def logical_or(t1, t2) -> DNDarray:
+    return _operations.binary_op(jnp.logical_or, t1, t2)
+
+
+def logical_xor(t1, t2) -> DNDarray:
+    return _operations.binary_op(jnp.logical_xor, t1, t2)
+
+
+def signbit(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.signbit, x, out)

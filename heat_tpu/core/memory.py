@@ -1,0 +1,28 @@
+"""Memory helpers (reference heat/core/memory.py:1-96)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dndarray import DNDarray
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(x: DNDarray) -> DNDarray:
+    """A (logical) copy of the array (reference ``memory.py:14``). jax.Arrays are
+    immutable, so this is a metadata-fresh wrapper over the same buffers."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, got {type(x)}")
+    return DNDarray(x.larray, x.gshape, x.dtype, x.split, x.device, x.comm, x.balanced)
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """Memory layout normalisation (reference ``memory.py:40``). XLA owns physical
+    layouts on TPU (tiled HBM layouts, not strided C/F order), so only 'C' is accepted
+    and the call is the identity."""
+    if order == "K":
+        raise NotImplementedError("Internal usage of torch.clone() means losing original memory layout for now.")
+    if order not in ("C",):
+        raise ValueError(f"only row-major ('C') layout is supported on TPU, got {order!r}")
+    return x

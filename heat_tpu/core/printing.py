@@ -1,0 +1,85 @@
+"""Printing of distributed arrays (reference heat/core/printing.py:30-308).
+
+The reference gathers shards to rank 0 (with summarisation for large arrays) and prints
+there. A global ``jax.Array`` already exposes the global value on every controller, so
+"global printing" is direct; ``local_printing`` switches to per-shard display.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "get_printoptions",
+    "global_printing",
+    "local_printing",
+    "print0",
+    "set_printoptions",
+]
+
+# summarisation thresholds mirroring the reference/torch defaults
+__PRINT_OPTIONS = dict(precision=4, threshold=1000, edgeitems=3, linewidth=120, sci_mode=None)
+__LOCAL_PRINTING = False
+
+
+def get_printoptions() -> dict:
+    """View of the current print options (reference ``printing.py:21``)."""
+    return dict(__PRINT_OPTIONS)
+
+
+def global_printing() -> None:
+    """Print global values (default; reference ``printing.py:62``)."""
+    global __LOCAL_PRINTING
+    __LOCAL_PRINTING = False
+
+
+def local_printing() -> None:
+    """Print each process's local shards only (reference ``printing.py:30``)."""
+    global __LOCAL_PRINTING
+    __LOCAL_PRINTING = True
+
+
+def print0(*args, **kwargs) -> None:
+    """Print on (process) rank 0 only (reference ``printing.py:100``)."""
+    import jax
+
+    if jax.process_index() == 0:
+        print(*args, **kwargs)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None):
+    """Configure printing (reference ``printing.py:150``)."""
+    if profile == "default":
+        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120)
+    elif profile == "short":
+        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120)
+    elif profile == "full":
+        __PRINT_OPTIONS.update(precision=4, threshold=np.inf, edgeitems=3, linewidth=120)
+    for k, v in dict(
+        precision=precision, threshold=threshold, edgeitems=edgeitems, linewidth=linewidth, sci_mode=sci_mode
+    ).items():
+        if v is not None:
+            __PRINT_OPTIONS[k] = v
+
+
+def __str__(dndarray) -> str:
+    """Render a DNDarray (reference ``printing.py:184``)."""
+    opts = __PRINT_OPTIONS
+    if __LOCAL_PRINTING:
+        shards = "\n".join(
+            f"  device {i}: {np.array2string(np.asarray(s), precision=opts['precision'])}"
+            for i, s in enumerate(dndarray.lshards)
+        )
+        return (
+            f"DNDarray(local shards, gshape={dndarray.gshape}, split={dndarray.split}):\n{shards}"
+        )
+    value = np.asarray(dndarray.larray)
+    body = np.array2string(
+        value,
+        precision=opts["precision"],
+        threshold=opts["threshold"],
+        edgeitems=opts["edgeitems"],
+        max_line_width=opts["linewidth"],
+        separator=", ",
+    )
+    return f"DNDarray({body}, dtype=ht.{dndarray.dtype}, device={dndarray.device}, split={dndarray.split})"

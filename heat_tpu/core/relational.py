@@ -1,0 +1,63 @@
+"""Relational operations (reference heat/core/relational.py, 12 exports)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = ["eq", "equal", "ge", "greater", "greater_equal", "gt", "le", "less", "less_equal", "lt", "ne", "not_equal"]
+
+
+def eq(t1, t2) -> DNDarray:
+    """Element-wise ``==`` (reference ``relational.py`` eq)."""
+    return _operations.binary_op(jnp.equal, t1, t2)
+
+
+def equal(t1, t2) -> bool:
+    """True iff all elements equal — a collective scalar verdict (reference
+    ``relational.py`` equal, which Allreduces the local verdicts)."""
+    from . import factories
+
+    a = t1 if isinstance(t1, DNDarray) else factories.array(t1)
+    b = t2 if isinstance(t2, DNDarray) else factories.array(t2)
+    try:
+        return bool(jnp.array_equal(a.larray, b.larray))
+    except (TypeError, ValueError):
+        return False
+
+
+def ge(t1, t2) -> DNDarray:
+    return _operations.binary_op(jnp.greater_equal, t1, t2)
+
+
+greater_equal = ge
+
+
+def gt(t1, t2) -> DNDarray:
+    return _operations.binary_op(jnp.greater, t1, t2)
+
+
+greater = gt
+
+
+def le(t1, t2) -> DNDarray:
+    return _operations.binary_op(jnp.less_equal, t1, t2)
+
+
+less_equal = le
+
+
+def lt(t1, t2) -> DNDarray:
+    return _operations.binary_op(jnp.less, t1, t2)
+
+
+less = lt
+
+
+def ne(t1, t2) -> DNDarray:
+    return _operations.binary_op(jnp.not_equal, t1, t2)
+
+
+not_equal = ne

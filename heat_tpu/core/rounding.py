@@ -1,0 +1,77 @@
+"""Rounding operations (reference heat/core/rounding.py, 11 exports)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sgn", "sign", "trunc"]
+
+
+def abs(x, out=None, dtype=None) -> DNDarray:  # noqa: A001
+    """Element-wise absolute value (reference ``rounding.py`` abs)."""
+    if dtype is not None and not issubclass(types.canonical_heat_type(dtype), types.number):
+        raise TypeError("dtype must be a heat data type")
+    res = _operations.local_op(jnp.abs, x, out)
+    if dtype is not None:
+        res = res.astype(dtype, copy=False)
+    return res
+
+
+absolute = abs
+
+
+def ceil(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.ceil, x, out)
+
+
+def clip(x: DNDarray, min=None, max=None, out=None) -> DNDarray:
+    """Clip values to [min, max] (reference ``rounding.py`` clip)."""
+    if min is None and max is None:
+        raise ValueError("either min or max must be set")
+    lo = min.larray if isinstance(min, DNDarray) else min
+    hi = max.larray if isinstance(max, DNDarray) else max
+    return _operations.local_op(jnp.clip, x, out, min=lo, max=hi)
+
+
+def fabs(x, out=None) -> DNDarray:
+    """Float absolute value (reference ``rounding.py`` fabs)."""
+    return _operations.local_op(jnp.fabs, x, out)
+
+
+def floor(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.floor, x, out)
+
+
+def modf(x: DNDarray, out=None):
+    """Fractional and integral parts (reference ``rounding.py`` modf)."""
+    frac = _operations.local_op(lambda v: jnp.modf(v)[0], x, out[0] if out else None)
+    intg = _operations.local_op(lambda v: jnp.modf(v)[1], x, out[1] if out else None)
+    return frac, intg
+
+
+def round(x: DNDarray, decimals: int = 0, out=None, dtype=None) -> DNDarray:  # noqa: A001
+    res = _operations.local_op(jnp.round, x, out, decimals=decimals)
+    if dtype is not None:
+        res = res.astype(dtype, copy=False)
+    return res
+
+
+def sgn(x, out=None) -> DNDarray:
+    """Sign (complex: x/|x|) (reference ``rounding.py`` sgn)."""
+    return _operations.local_op(jnp.sign, x, out)
+
+
+def sign(x, out=None) -> DNDarray:
+    """Sign; complex inputs use sign of the real part (reference ``rounding.py`` sign)."""
+    if isinstance(x, DNDarray) and types.heat_type_is_complexfloating(x.dtype):
+        return _operations.local_op(lambda v: jnp.sign(v.real).astype(v.dtype), x, out)
+    return _operations.local_op(jnp.sign, x, out)
+
+
+def trunc(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.trunc, x, out)

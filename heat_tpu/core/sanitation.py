@@ -1,0 +1,103 @@
+"""Input validation and distribution matching (reference heat/core/sanitation.py:32-361)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import types
+from .communication import MeshCommunication
+from .dndarray import DNDarray
+
+__all__ = [
+    "sanitize_in",
+    "sanitize_infinity",
+    "sanitize_in_tensor",
+    "sanitize_lshape",
+    "sanitize_out",
+    "sanitize_distribution",
+    "scalar_to_1d",
+]
+
+
+def sanitize_in(x: object) -> None:
+    """Verify ``x`` is a DNDarray (reference ``sanitation.py:159``)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input must be a DNDarray, is {type(x)}")
+
+
+def sanitize_in_tensor(x: object) -> None:
+    """Verify ``x`` is a jax.Array (reference checks torch.Tensor, ``sanitation.py:186``)."""
+    import jax
+
+    if not isinstance(x, jax.Array):
+        raise TypeError(f"input must be a jax.Array, is {type(x)}")
+
+
+def sanitize_infinity(x: Union[DNDarray, "jnp.ndarray"]) -> Union[int, float]:
+    """Largest representable value for ``x``'s dtype (reference ``sanitation.py:140``)."""
+    dtype = x.dtype.jax_type() if isinstance(x, DNDarray) else x.dtype
+    if jnp.issubdtype(dtype, jnp.integer):
+        return int(jnp.iinfo(dtype).max)
+    return float(jnp.finfo(dtype).max)
+
+
+def sanitize_lshape(array: DNDarray, tensor) -> None:
+    """Verify a local tensor is a valid local shard of ``array`` (reference ``:213``)."""
+    tshape = tuple(tensor.shape)
+    if tshape == tuple(array.lshape) or tshape == tuple(array.gshape):
+        return
+    raise ValueError(f"local tensor shape {tshape} does not match chunk shape {array.lshape}")
+
+
+def sanitize_out(
+    out: object,
+    output_shape: Sequence[int],
+    output_split: Optional[int],
+    output_device,
+    output_comm=None,
+) -> None:
+    """Verify ``out`` buffer metadata (reference ``sanitation.py:255``)."""
+    sanitize_in(out)
+    if tuple(out.shape) != tuple(output_shape):
+        raise ValueError(f"Expecting output buffer of shape {tuple(output_shape)}, got {tuple(out.shape)}")
+    if out.split != output_split:
+        # match the reference behaviour: resplit the out buffer to the required split
+        out.resplit_(output_split)
+
+
+def sanitize_distribution(
+    *args: DNDarray, target: DNDarray, diff_map: Optional[DNDarray] = None
+) -> Union[DNDarray, List[DNDarray]]:
+    """Distribute ``args`` like ``target`` (reference ``sanitation.py:32``).
+
+    On TPU this is a pure resplit: canonical chunks mean two arrays with the same split
+    are automatically aligned, so matching distribution = matching split axis.
+    """
+    out = []
+    tsplit = target.split
+    for arg in args:
+        sanitize_in(arg)
+        if arg.split == tsplit:
+            out.append(arg)
+        else:
+            out.append(arg.resplit(tsplit))
+    return out[0] if len(out) == 1 else out
+
+
+def scalar_to_1d(x: DNDarray) -> DNDarray:
+    """Turn a scalar DNDarray into a 1-element 1-D DNDarray (reference ``sanitation.py:339``)."""
+    if x.ndim == 1 and x.size == 1:
+        return x
+    return DNDarray(
+        x.larray.reshape(1),
+        (1,),
+        x.dtype,
+        None,
+        x.device,
+        x.comm,
+        True,
+    )

@@ -1,0 +1,122 @@
+"""Trigonometric and hyperbolic functions (reference heat/core/trigonometrics.py, 24 exports)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = [
+    "arccos",
+    "acos",
+    "arccosh",
+    "acosh",
+    "arcsin",
+    "asin",
+    "arcsinh",
+    "asinh",
+    "arctan",
+    "atan",
+    "arctanh",
+    "atanh",
+    "arctan2",
+    "atan2",
+    "cos",
+    "cosh",
+    "deg2rad",
+    "degrees",
+    "rad2deg",
+    "radians",
+    "sin",
+    "sinh",
+    "tan",
+    "tanh",
+]
+
+
+def arccos(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.arccos, x, out)
+
+
+acos = arccos
+
+
+def arccosh(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.arccosh, x, out)
+
+
+acosh = arccosh
+
+
+def arcsin(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.arcsin, x, out)
+
+
+asin = arcsin
+
+
+def arcsinh(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.arcsinh, x, out)
+
+
+asinh = arcsinh
+
+
+def arctan(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.arctan, x, out)
+
+
+atan = arctan
+
+
+def arctanh(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.arctanh, x, out)
+
+
+atanh = arctanh
+
+
+def arctan2(t1, t2, out=None, where=None) -> DNDarray:
+    return _operations.binary_op(jnp.arctan2, t1, t2, out, where)
+
+
+atan2 = arctan2
+
+
+def cos(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.cos, x, out)
+
+
+def cosh(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.cosh, x, out)
+
+
+def deg2rad(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.deg2rad, x, out)
+
+
+radians = deg2rad
+
+
+def rad2deg(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.rad2deg, x, out)
+
+
+degrees = rad2deg
+
+
+def sin(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.sin, x, out)
+
+
+def sinh(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.sinh, x, out)
+
+
+def tan(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.tan, x, out)
+
+
+def tanh(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.tanh, x, out)

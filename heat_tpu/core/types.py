@@ -1,0 +1,498 @@
+"""Type system: Heat's datatype class hierarchy over JAX dtypes.
+
+Mirrors reference ``heat/core/types.py`` (1054 LoC): a class hierarchy
+``datatype → bool/number → integer/floating/complexfloating → concrete types`` where each
+concrete class knows its backend dtype (``jax_type()`` here, ``torch_type()`` in the
+reference, ``types.py:85-493``), plus the query/promotion helpers ``canonical_heat_type``
+(``:494``), ``heat_type_of`` (``:567``), ``can_cast`` (``:673``), ``promote_types``
+(``:838``), ``result_type`` (``:870``) and ``finfo``/``iinfo`` (``:952``).
+
+TPU-first deltas: ``bfloat16`` is a first-class type (the MXU's native input dtype);
+``float16`` exists for completeness; ``float64`` is available because x64 mode is enabled
+at package import, but the *default* floating type stays ``float32`` exactly like the
+reference.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Type, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "datatype",
+    "number",
+    "integer",
+    "signedinteger",
+    "unsignedinteger",
+    "floating",
+    "flexible",
+    "complexfloating",
+    "bool",
+    "bool_",
+    "int8",
+    "byte",
+    "int16",
+    "short",
+    "int32",
+    "int",
+    "int64",
+    "long",
+    "uint8",
+    "ubyte",
+    "float16",
+    "half",
+    "bfloat16",
+    "float32",
+    "float",
+    "float_",
+    "float64",
+    "double",
+    "complex64",
+    "cfloat",
+    "csingle",
+    "complex128",
+    "cdouble",
+    "canonical_heat_type",
+    "heat_type_of",
+    "heat_type_is_complexfloating",
+    "heat_type_is_exact",
+    "heat_type_is_inexact",
+    "issubdtype",
+    "iscomplexobj",
+    "promote_types",
+    "result_type",
+    "can_cast",
+    "finfo",
+    "iinfo",
+]
+
+
+class _DatatypeMeta(type):
+    def __repr__(cls):
+        return f"heat_tpu.{cls.__name__}"
+
+    def __str__(cls):
+        return cls.__name__
+
+    def __instancecheck__(cls, instance):
+        # ht.float32(...) returns a DNDarray, so instance checks refer to the hierarchy.
+        return super().__instancecheck__(instance)
+
+
+class datatype(metaclass=_DatatypeMeta):
+    """Base class of the type hierarchy (reference ``types.py:40``).
+
+    Calling a concrete type casts data to a :class:`~heat_tpu.core.dndarray.DNDarray`
+    of that type: ``ht.float32([1, 2])`` (reference ``types.py:85``).
+    """
+
+    _jax_type = None
+    _char = None
+
+    def __new__(cls, *value, device=None, comm=None):
+        from . import factories
+
+        if cls._jax_type is None:
+            raise TypeError(f"cannot instantiate abstract type {cls.__name__}")
+        if len(value) == 0:
+            value = ((0,),)  # zero scalar, like the reference's default
+        if len(value) != 1:
+            raise TypeError(f"{cls.__name__} takes at most 1 argument, got {len(value)}")
+        return factories.array(value[0], dtype=cls, device=device, comm=comm)
+
+    @classmethod
+    def jax_type(cls):
+        """The backing ``jnp`` dtype (reference ``torch_type()``)."""
+        if cls._jax_type is None:
+            raise TypeError(f"abstract type {cls.__name__} has no backend dtype")
+        return cls._jax_type
+
+    # keep the reference's name so ported user code works
+    torch_type = jax_type
+
+    @classmethod
+    def char(cls):
+        """Short dtype character code (reference ``types.py`` per-class ``char``)."""
+        if cls._char is None:
+            raise TypeError(f"abstract type {cls.__name__} has no character code")
+        return cls._char
+
+
+class bool(datatype):  # noqa: A001 — shadows builtins.bool on purpose, like the reference
+    _jax_type = jnp.bool_
+    _char = "u1"
+
+
+bool_ = bool
+
+
+class number(datatype):
+    pass
+
+
+class integer(number):
+    pass
+
+
+class signedinteger(integer):
+    pass
+
+
+class unsignedinteger(integer):
+    pass
+
+
+class floating(number):
+    pass
+
+
+class flexible(datatype):
+    pass
+
+
+class complexfloating(number):
+    pass
+
+
+class int8(signedinteger):
+    _jax_type = jnp.int8
+    _char = "b"
+
+
+byte = int8
+
+
+class int16(signedinteger):
+    _jax_type = jnp.int16
+    _char = "h"
+
+
+short = int16
+
+
+class int32(signedinteger):
+    _jax_type = jnp.int32
+    _char = "i"
+
+
+int = int32  # noqa: A001
+
+
+class int64(signedinteger):
+    _jax_type = jnp.int64
+    _char = "l"
+
+
+long = int64
+
+
+class uint8(unsignedinteger):
+    _jax_type = jnp.uint8
+    _char = "B"
+
+
+ubyte = uint8
+
+
+class float16(floating):
+    _jax_type = jnp.float16
+    _char = "e"
+
+
+half = float16
+
+
+class bfloat16(floating):
+    """TPU-native 16-bit float: MXU inputs are bf16, accumulation is f32."""
+
+    _jax_type = jnp.bfloat16
+    _char = "E"
+
+
+class float32(floating):
+    _jax_type = jnp.float32
+    _char = "f"
+
+
+float = float32  # noqa: A001
+float_ = float32
+
+
+class float64(floating):
+    _jax_type = jnp.float64
+    _char = "d"
+
+
+double = float64
+
+
+class complex64(complexfloating):
+    _jax_type = jnp.complex64
+    _char = "F"
+
+
+cfloat = complex64
+csingle = complex64
+
+
+class complex128(complexfloating):
+    _jax_type = jnp.complex128
+    _char = "D"
+
+
+cdouble = complex128
+
+
+# --------------------------------------------------------------------------- registries
+_HEAT_TYPES = [
+    bool,
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+]
+
+__JAX_TO_HEAT = {np.dtype(t._jax_type): t for t in _HEAT_TYPES}
+
+__CANONICAL = {}
+for _t in _HEAT_TYPES:
+    __CANONICAL[_t] = _t
+    __CANONICAL[_t.__name__] = _t
+    __CANONICAL[np.dtype(_t._jax_type)] = _t
+    __CANONICAL[np.dtype(_t._jax_type).name] = _t
+# python builtins
+__CANONICAL[builtins.bool] = bool
+__CANONICAL[builtins.int] = int64
+__CANONICAL[builtins.float] = float32
+__CANONICAL[builtins.complex] = complex128
+# numpy scalar classes
+for _np_t in (np.bool_, np.uint8, np.int8, np.int16, np.int32, np.int64,
+              np.float16, np.float32, np.float64, np.complex64, np.complex128):
+    __CANONICAL[_np_t] = __JAX_TO_HEAT[np.dtype(_np_t)]
+
+
+def canonical_heat_type(a_type: Any) -> Type[datatype]:
+    """Canonicalise str / numpy / jax / python / heat dtypes to the heat class
+    (reference ``types.py:494``)."""
+    if isinstance(a_type, type) and issubclass(a_type, datatype):
+        if a_type._jax_type is None:
+            raise TypeError(f"data type {a_type!r} is abstract")
+        return a_type
+    try:
+        hashed = __CANONICAL.get(a_type)
+        if hashed is not None:
+            return hashed
+    except TypeError:
+        pass
+    try:
+        return __JAX_TO_HEAT[np.dtype(a_type)]
+    except (TypeError, KeyError):
+        raise TypeError(f"data type {a_type!r} is not understood") from None
+
+
+def heat_type_of(obj: Any) -> Type[datatype]:
+    """Heat type of an arbitrary object's elements (reference ``types.py:567``)."""
+    from .dndarray import DNDarray
+
+    if isinstance(obj, DNDarray):
+        return obj.dtype
+    dt = getattr(obj, "dtype", None)
+    if dt is not None:
+        return canonical_heat_type(dt)
+    if isinstance(obj, (list, tuple)):
+        return canonical_heat_type(np.asarray(obj).dtype)
+    return canonical_heat_type(type(obj))
+
+
+def heat_type_is_exact(ht_dtype: Any) -> builtins.bool:
+    """True for integer/bool types (reference ``types.py:645``)."""
+    t = canonical_heat_type(ht_dtype)
+    return issubclass(t, integer) or t is bool
+
+
+def heat_type_is_inexact(ht_dtype: Any) -> builtins.bool:
+    """True for floating/complex types (reference ``types.py:658``)."""
+    t = canonical_heat_type(ht_dtype)
+    return issubclass(t, (floating, complexfloating))
+
+
+def heat_type_is_complexfloating(ht_dtype: Any) -> builtins.bool:
+    t = canonical_heat_type(ht_dtype)
+    return issubclass(t, complexfloating)
+
+
+def issubdtype(arg1: Any, arg2: Any) -> builtins.bool:
+    """NumPy-style abstract dtype comparison on the heat hierarchy."""
+    abstract = {
+        number, integer, signedinteger, unsignedinteger, floating, complexfloating,
+        flexible, datatype,
+    }
+    t1 = arg1 if (isinstance(arg1, type) and issubclass(arg1, datatype)) else canonical_heat_type(arg1)
+    if isinstance(arg2, type) and issubclass(arg2, datatype):
+        return issubclass(t1, arg2)
+    return issubclass(t1, canonical_heat_type(arg2))
+
+
+def iscomplexobj(x: Any) -> builtins.bool:
+    return heat_type_is_complexfloating(heat_type_of(x))
+
+
+def promote_types(type1: Any, type2: Any) -> Type[datatype]:
+    """Smallest type safely holding both (reference ``types.py:838``). Uses JAX's promotion
+    lattice (x64 enabled), which includes bfloat16; e.g.
+    ``promote_types(bfloat16, float16) → float32``."""
+    t1 = canonical_heat_type(type1)
+    t2 = canonical_heat_type(type2)
+    return canonical_heat_type(jnp.promote_types(t1.jax_type(), t2.jax_type()))
+
+
+def result_type(*arrays_and_types: Any) -> Type[datatype]:
+    """Promotion over arrays, scalars and dtypes (reference ``types.py:870``).
+
+    Python float/complex scalars are *weak* (torch semantics, which the reference
+    inherits): they promote int arrays to the default float (f32) — not to f64 — and
+    never widen an existing float dtype.
+    """
+    from .dndarray import DNDarray
+
+    args = []
+    weak_float = False
+    strong_f64 = False
+    strong_c128 = False
+    for a in arrays_and_types:
+        if isinstance(a, DNDarray):
+            args.append(a.larray)
+            dt = np.dtype(a.dtype.jax_type())
+        elif isinstance(a, type) and issubclass(a, datatype):
+            args.append(a.jax_type())
+            dt = np.dtype(a.jax_type())
+        elif isinstance(a, builtins.bool):
+            args.append(a)
+            continue
+        elif isinstance(a, (builtins.float, builtins.complex)) and not isinstance(
+            a, (np.floating, np.complexfloating)
+        ):
+            weak_float = True
+            args.append(a)
+            continue
+        elif isinstance(a, builtins.int) and not isinstance(a, np.integer):
+            args.append(a)
+            continue
+        else:
+            args.append(a)
+            dt = np.dtype(getattr(a, "dtype", np.asarray(a).dtype))
+        strong_f64 |= dt == np.float64
+        strong_c128 |= dt == np.complex128
+    res = canonical_heat_type(jnp.result_type(*args))
+    if weak_float:
+        if res is float64 and not strong_f64:
+            return float32
+        if res is complex128 and not strong_c128:
+            return complex64
+    return res
+
+
+def can_cast(from_: Any, to: Any, casting: str = "intuitive") -> builtins.bool:
+    """Whether a cast is permitted under the given rule (reference ``types.py:673``).
+
+    Rules: ``"no"``, ``"safe"``, ``"same_kind"``, ``"unsafe"`` (NumPy semantics) plus the
+    reference's default ``"intuitive"`` (safe + int64→float32 style convenience casts).
+    """
+    from .dndarray import DNDarray
+
+    if isinstance(from_, DNDarray):
+        from_t = from_.dtype
+    elif isinstance(from_, (builtins.int, builtins.float, builtins.complex, builtins.bool)):
+        return np.can_cast(from_, np.dtype(canonical_heat_type(to).jax_type()))
+    else:
+        from_t = canonical_heat_type(from_)
+    to_t = canonical_heat_type(to)
+    if casting == "no":
+        return from_t is to_t
+    if casting == "unsafe":
+        return True
+    f_np, t_np = np.dtype(from_t.jax_type()), np.dtype(to_t.jax_type())
+
+    def _kind(d):
+        if d == np.dtype(jnp.bfloat16):
+            return "f"
+        return d.kind
+
+    if casting == "same_kind":
+        order = {"b": 0, "u": 1, "i": 1, "f": 2, "c": 3}
+        return order[_kind(f_np)] <= order[_kind(t_np)]
+    if casting in ("safe", "intuitive"):
+        if f_np == t_np:
+            return True
+        # bfloat16 is outside numpy's native lattice; treat like float16-width float
+        if _kind(f_np) == "f" and f_np.itemsize <= 2:
+            f_np = np.dtype(np.float16)
+        if _kind(t_np) == "f" and t_np.itemsize <= 2:
+            t_np = np.dtype(np.float16)
+        safe = np.can_cast(f_np, t_np)
+        if casting == "safe":
+            return safe
+        # "intuitive": also allow any-int → any-float and float↔complex width-matched
+        if not safe:
+            if _kind(f_np) in "biu" and _kind(t_np) in "fc":
+                return True
+        return safe
+    raise ValueError(f"invalid casting rule {casting!r}")
+
+
+class finfo:
+    """Machine limits for floating types (reference ``types.py:952``)."""
+
+    def __new__(cls, dtype):
+        t = canonical_heat_type(dtype)
+        if not issubclass(t, (floating, complexfloating)):
+            raise TypeError(f"data type {t!r} not inexact")
+        return super().__new__(cls)
+
+    def __init__(self, dtype):
+        t = canonical_heat_type(dtype)
+        info = jnp.finfo(t.jax_type())
+        self.bits = info.bits
+        self.eps = builtins.float(info.eps)
+        self.max = builtins.float(info.max)
+        self.min = builtins.float(info.min)
+        self.tiny = builtins.float(info.tiny)
+        self.dtype = t
+
+    def __repr__(self):
+        return f"finfo(dtype={self.dtype}, eps={self.eps}, max={self.max}, min={self.min})"
+
+
+class iinfo:
+    """Machine limits for integer types (reference ``types.py:1007``)."""
+
+    def __new__(cls, dtype):
+        t = canonical_heat_type(dtype)
+        if not (issubclass(t, integer) or t is bool):
+            raise TypeError(f"data type {t!r} not exact")
+        return super().__new__(cls)
+
+    def __init__(self, dtype):
+        t = canonical_heat_type(dtype)
+        if t is bool:
+            self.bits, self.max, self.min = 8, 1, 0
+        else:
+            info = jnp.iinfo(t.jax_type())
+            self.bits = info.bits
+            self.max = builtins.int(info.max)
+            self.min = builtins.int(info.min)
+        self.dtype = t
+
+    def __repr__(self):
+        return f"iinfo(dtype={self.dtype}, max={self.max}, min={self.min})"

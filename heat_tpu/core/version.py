@@ -1,0 +1,19 @@
+"""Version information for heat_tpu.
+
+Mirrors the reference's version module (heat/core/version.py) with a plain
+semantic version triple.
+"""
+
+major: int = 0
+"""Major version number."""
+minor: int = 1
+"""Minor version number."""
+micro: int = 0
+"""Micro (patch) version number."""
+extension: str = "dev"
+"""Pre-release tag."""
+
+if not extension:
+    __version__ = f"{major}.{minor}.{micro}"
+else:
+    __version__ = f"{major}.{minor}.{micro}-{extension}"

@@ -1,0 +1,382 @@
+"""Split-sweep parity tests of the elementwise/reduction op surface
+(reference heat/core/tests/test_arithmetics.py et al., driven by assert_func_equal)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestArithmetics(TestCase):
+    def test_add_sub_mul_div(self):
+        np_a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        np_b = np.arange(12, 0, -1, dtype=np.float32).reshape(3, 4)
+        for split_a in (None, 0, 1):
+            for split_b in (None, 0, 1):
+                a = ht.array(np_a, split=split_a)
+                b = ht.array(np_b, split=split_b)
+                self.assert_array_equal(ht.add(a, b), np_a + np_b)
+                self.assert_array_equal(ht.sub(a, b), np_a - np_b)
+                self.assert_array_equal(ht.mul(a, b), np_a * np_b)
+                self.assert_array_equal(ht.div(a, b), np_a / np_b)
+
+    def test_split_rules(self):
+        a = ht.ones((4, 5), split=0)
+        b = ht.ones((4, 5), split=None)
+        self.assertEqual(ht.add(a, b).split, 0)
+        self.assertEqual(ht.add(b, a).split, 0)
+        c = ht.ones((4, 5), split=1)
+        self.assertEqual(ht.add(a, c).split, 0)  # t1 dominates
+        # broadcasting shifts the split index
+        d = ht.ones((5,), split=0)
+        self.assertEqual(ht.add(a, d).split, 0)
+        self.assertEqual(ht.add(d, a).split, 1)
+
+    def test_scalars(self):
+        a = ht.arange(5, split=0)
+        self.assert_array_equal(a + 2, np.arange(5) + 2)
+        self.assert_array_equal(2 + a, np.arange(5) + 2)
+        self.assert_array_equal(2.5 * a, np.arange(5) * 2.5)
+        r = ht.add(2, 3)
+        self.assertEqual(r.item(), 5)
+
+    def test_sum_prod(self):
+        self.assert_func_equal((4, 6), ht.sum, np.sum)
+        self.assert_func_equal((4, 6), lambda x: ht.sum(x, axis=0), lambda x: np.sum(x, axis=0))
+        self.assert_func_equal((4, 6), lambda x: ht.sum(x, axis=1), lambda x: np.sum(x, axis=1))
+        self.assert_func_equal(
+            (4, 6), lambda x: ht.sum(x, axis=1, keepdims=True), lambda x: np.sum(x, axis=1, keepdims=True)
+        )
+        np_a = np.full((3, 4), 1.1, dtype=np.float64)
+        self.assert_func_equal(np_a, ht.prod, np.prod)
+
+    def test_reduce_split_bookkeeping(self):
+        a = ht.ones((4, 6, 8), split=1)
+        self.assertEqual(ht.sum(a, axis=1).split, None)
+        self.assertEqual(ht.sum(a, axis=0).split, 0)
+        self.assertEqual(ht.sum(a, axis=2).split, 1)
+        self.assertEqual(ht.sum(a, axis=(0, 2)).split, 0)
+        self.assertEqual(ht.sum(a).split, None)
+        self.assertEqual(ht.sum(a, axis=0, keepdims=True).split, 1)
+
+    def test_cumsum_cumprod(self):
+        self.assert_func_equal((4, 5), lambda x: ht.cumsum(x, 0), lambda x: np.cumsum(x, 0),
+                               data_types=(np.float32,))
+        self.assert_func_equal((4, 5), lambda x: ht.cumsum(x, 1), lambda x: np.cumsum(x, 1),
+                               data_types=(np.float32,))
+        np_a = np.random.default_rng(0).random((3, 4)).astype(np.float64) + 0.5
+        self.assert_func_equal(np_a, lambda x: ht.cumprod(x, 0), lambda x: np.cumprod(x, 0))
+
+    def test_nan_reductions(self):
+        np_a = np.array([[1.0, np.nan, 3.0], [np.nan, 5.0, 6.0]], dtype=np.float32)
+        self.assert_func_equal(np_a, ht.nansum, np.nansum)
+        self.assert_func_equal(np_a, ht.nanprod, np.nanprod)
+        self.assert_func_equal(np_a, ht.nan_to_num, np.nan_to_num)
+
+    def test_diff(self):
+        self.assert_func_equal((5, 6), ht.diff, np.diff, data_types=(np.float32, np.int32))
+        self.assert_func_equal((5, 6), lambda x: ht.diff(x, axis=0), lambda x: np.diff(x, axis=0),
+                               data_types=(np.float32,))
+        self.assert_func_equal((5, 6), lambda x: ht.diff(x, n=2), lambda x: np.diff(x, n=2),
+                               data_types=(np.float32,))
+
+    def test_bitwise(self):
+        np_a = np.arange(16, dtype=np.int32).reshape(4, 4)
+        np_b = (np_a * 3 + 1).astype(np.int32)
+        for split in (None, 0, 1):
+            a, b = ht.array(np_a, split=split), ht.array(np_b, split=split)
+            self.assert_array_equal(ht.bitwise_and(a, b), np_a & np_b)
+            self.assert_array_equal(ht.bitwise_or(a, b), np_a | np_b)
+            self.assert_array_equal(ht.bitwise_xor(a, b), np_a ^ np_b)
+            self.assert_array_equal(ht.invert(a), ~np_a)
+            self.assert_array_equal(ht.left_shift(a, 1), np_a << 1)
+            self.assert_array_equal(ht.right_shift(a, 1), np_a >> 1)
+        with self.assertRaises(TypeError):
+            ht.bitwise_and(ht.ones(3), ht.ones(3))
+
+    def test_int_ops(self):
+        np_a = np.arange(1, 13, dtype=np.int32).reshape(3, 4)
+        np_b = np.arange(12, 0, -1, dtype=np.int32).reshape(3, 4)
+        for split in (None, 0):
+            a, b = ht.array(np_a, split=split), ht.array(np_b, split=split)
+            self.assert_array_equal(ht.gcd(a, b), np.gcd(np_a, np_b))
+            self.assert_array_equal(ht.lcm(a, b), np.lcm(np_a, np_b))
+
+    def test_mod_fmod_floordiv(self):
+        np_a = np.array([[-7.0, 5.5, 3.0], [2.0, -4.5, 9.0]], dtype=np.float32)
+        np_b = np.array([[2.0, 2.0, -2.0], [3.0, 3.0, 4.0]], dtype=np.float32)
+        a, b = ht.array(np_a, split=0), ht.array(np_b, split=0)
+        self.assert_array_equal(ht.mod(a, b), np.mod(np_a, np_b))
+        self.assert_array_equal(ht.fmod(a, b), np.fmod(np_a, np_b))
+        self.assert_array_equal(ht.floordiv(a, b), np_a // np_b)
+        d, m = ht.divmod(a, b)
+        self.assert_array_equal(d, np_a // np_b)
+        self.assert_array_equal(m, np.mod(np_a, np_b))
+
+    def test_unary(self):
+        np_a = np.linspace(-3, 3, 12).astype(np.float32).reshape(3, 4)
+        self.assert_func_equal(np_a, ht.neg, np.negative)
+        self.assert_func_equal(np_a, ht.pos, np.positive)
+        self.assert_func_equal(np_a, lambda x: ht.pow(x, 2), lambda x: np.power(x, 2))
+        self.assert_func_equal(np_a, lambda x: ht.copysign(x, -1.0), lambda x: np.copysign(x, -1.0))
+        self.assert_func_equal(np_a, lambda x: ht.hypot(x, 3.0), lambda x: np.hypot(x, 3.0))
+
+    def test_out_and_where(self):
+        np_a = np.arange(6, dtype=np.float32)
+        a = ht.array(np_a, split=0)
+        out = ht.zeros(6, split=0)
+        res = ht.add(a, 1, out=out)
+        self.assertIs(res, out)
+        self.assert_array_equal(out, np_a + 1)
+        masked = ht.add(a, 10, where=ht.array(np_a > 2, split=0))
+        np.testing.assert_array_equal(masked.numpy()[3:], (np_a + 10)[3:])
+
+
+class TestRounding(TestCase):
+    def test_rounding_surface(self):
+        np_a = np.array([[-1.7, -0.2, 0.5], [1.5, 2.4, -3.9]], dtype=np.float32)
+        self.assert_func_equal(np_a, ht.abs, np.abs)
+        self.assert_func_equal(np_a, ht.fabs, np.fabs)
+        self.assert_func_equal(np_a, ht.ceil, np.ceil)
+        self.assert_func_equal(np_a, ht.floor, np.floor)
+        self.assert_func_equal(np_a, ht.trunc, np.trunc)
+        self.assert_func_equal(np_a, ht.round, np.round)
+        self.assert_func_equal(np_a, ht.sign, np.sign)
+        self.assert_func_equal(np_a, lambda x: ht.clip(x, -1, 1), lambda x: np.clip(x, -1, 1))
+        frac, intg = ht.modf(ht.array(np_a))
+        np.testing.assert_allclose(frac.numpy(), np.modf(np_a)[0], rtol=1e-6)
+        np.testing.assert_allclose(intg.numpy(), np.modf(np_a)[1], rtol=1e-6)
+
+
+class TestTrigExp(TestCase):
+    def test_trig(self):
+        np_a = np.linspace(-0.9, 0.9, 12, dtype=np.float32).reshape(3, 4)
+        for ht_f, np_f in [
+            (ht.sin, np.sin), (ht.cos, np.cos), (ht.tan, np.tan),
+            (ht.arcsin, np.arcsin), (ht.arccos, np.arccos), (ht.arctan, np.arctan),
+            (ht.sinh, np.sinh), (ht.cosh, np.cosh), (ht.tanh, np.tanh),
+            (ht.deg2rad, np.deg2rad), (ht.rad2deg, np.rad2deg),
+        ]:
+            self.assert_func_equal(np_a, ht_f, np_f)
+        self.assert_func_equal(np_a, lambda x: ht.arctan2(x, 0.5), lambda x: np.arctan2(x, 0.5))
+
+    def test_exp_log(self):
+        np_a = np.linspace(0.1, 4.0, 12, dtype=np.float32).reshape(3, 4)
+        for ht_f, np_f in [
+            (ht.exp, np.exp), (ht.expm1, np.expm1), (ht.exp2, np.exp2),
+            (ht.log, np.log), (ht.log2, np.log2), (ht.log10, np.log10),
+            (ht.log1p, np.log1p), (ht.sqrt, np.sqrt), (ht.square, np.square),
+        ]:
+            self.assert_func_equal(np_a, ht_f, np_f)
+        self.assert_func_equal(np_a, lambda x: ht.logaddexp(x, x), lambda x: np.logaddexp(x, x))
+
+
+class TestRelationalLogical(TestCase):
+    def test_relational(self):
+        np_a = np.arange(12).reshape(3, 4)
+        np_b = np.flip(np_a, 0).copy()
+        for split in (None, 0, 1):
+            a, b = ht.array(np_a, split=split), ht.array(np_b, split=split)
+            self.assert_array_equal(ht.eq(a, b), np_a == np_b)
+            self.assert_array_equal(ht.ne(a, b), np_a != np_b)
+            self.assert_array_equal(ht.lt(a, b), np_a < np_b)
+            self.assert_array_equal(ht.le(a, b), np_a <= np_b)
+            self.assert_array_equal(ht.gt(a, b), np_a > np_b)
+            self.assert_array_equal(ht.ge(a, b), np_a >= np_b)
+        self.assertTrue(ht.equal(ht.array(np_a), ht.array(np_a)))
+        self.assertFalse(ht.equal(ht.array(np_a), ht.array(np_b)))
+
+    def test_logical(self):
+        np_a = np.array([[True, False], [True, True]])
+        np_b = np.array([[False, False], [True, False]])
+        a, b = ht.array(np_a, split=0), ht.array(np_b, split=0)
+        self.assert_array_equal(ht.logical_and(a, b), np_a & np_b)
+        self.assert_array_equal(ht.logical_or(a, b), np_a | np_b)
+        self.assert_array_equal(ht.logical_xor(a, b), np_a ^ np_b)
+        self.assert_array_equal(ht.logical_not(a), ~np_a)
+
+    def test_all_any(self):
+        self.assert_func_equal((4, 5), lambda x: ht.all(x > -20000), lambda x: np.all(x > -20000))
+        np_a = np.array([[1, 0, 3], [4, 5, 0]])
+        for split in (None, 0, 1):
+            a = ht.array(np_a, split=split)
+            self.assert_array_equal(ht.all(a, axis=0), np.all(np_a, axis=0))
+            self.assert_array_equal(ht.any(a, axis=1), np.any(np_a, axis=1))
+
+    def test_closeness(self):
+        a = ht.array([1.0, 2.0, 3.0], split=0)
+        b = ht.array([1.0 + 1e-7, 2.0, 3.0], split=0)
+        self.assertTrue(ht.allclose(a, b))
+        self.assertFalse(ht.allclose(a, b + 1))
+        self.assert_array_equal(ht.isclose(a, b), np.isclose([1.0, 2.0, 3.0], [1.0 + 1e-7, 2.0, 3.0]))
+
+    def test_isfuncs(self):
+        np_a = np.array([[1.0, np.nan], [np.inf, -np.inf]], dtype=np.float32)
+        self.assert_func_equal(np_a, ht.isnan, np.isnan)
+        self.assert_func_equal(np_a, ht.isinf, np.isinf)
+        self.assert_func_equal(np_a, ht.isfinite, np.isfinite)
+        self.assert_func_equal(np_a, ht.isposinf, np.isposinf)
+        self.assert_func_equal(np_a, ht.isneginf, np.isneginf)
+        self.assert_func_equal(np_a, ht.signbit, np.signbit)
+
+
+class TestComplex(TestCase):
+    def test_complex_surface(self):
+        np_a = (np.arange(6) + 1j * np.arange(6, 0, -1)).astype(np.complex64).reshape(2, 3)
+        for split in (None, 0, 1):
+            a = ht.array(np_a, split=split)
+            self.assert_array_equal(a.real, np_a.real)
+            self.assert_array_equal(a.imag, np_a.imag)
+            self.assert_array_equal(ht.conj(a), np.conj(np_a))
+            self.assert_array_equal(ht.angle(a), np.angle(np_a))
+            self.assert_array_equal(ht.angle(a, deg=True), np.degrees(np.angle(np_a)))
+
+
+class TestLinalgBasics(TestCase):
+    def test_matmul_splits(self):
+        # north-star config #2: split-0 × split-1 matmul
+        np_a = np.random.default_rng(1).random((16, 12)).astype(np.float32)
+        np_b = np.random.default_rng(2).random((12, 8)).astype(np.float32)
+        expected = np_a @ np_b
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                a, b = ht.array(np_a, split=sa), ht.array(np_b, split=sb)
+                c = ht.matmul(a, b)
+                self.assert_array_equal(c, expected, rtol=1e-4)
+        self.assertEqual(ht.matmul(ht.ones((8, 4), split=0), ht.ones((4, 8))).split, 0)
+        self.assertEqual(ht.matmul(ht.ones((8, 4)), ht.ones((4, 8), split=1)).split, 1)
+        self.assertEqual(ht.matmul(ht.ones((8, 4), split=1), ht.ones((4, 8), split=0)).split, None)
+
+    def test_dot_vecdot_outer(self):
+        np_a = np.arange(5, dtype=np.float32)
+        np_b = np.arange(5, 0, -1).astype(np.float32)
+        a, b = ht.array(np_a, split=0), ht.array(np_b, split=0)
+        self.assertAlmostEqual(float(ht.dot(a, b)), float(np_a @ np_b), places=4)
+        self.assert_array_equal(ht.outer(a, b), np.outer(np_a, np_b))
+        self.assertAlmostEqual(float(ht.vdot(a, b)), float(np.vdot(np_a, np_b)), places=4)
+
+    def test_transpose(self):
+        np_a = np.arange(24).reshape(2, 3, 4)
+        for split in (None, 0, 1, 2):
+            a = ht.array(np_a, split=split)
+            t = ht.transpose(a)
+            np.testing.assert_array_equal(t.numpy(), np_a.T)
+            if split is not None:
+                self.assertEqual(t.split, 2 - split)
+            p = ht.transpose(a, (1, 0, 2))
+            np.testing.assert_array_equal(p.numpy(), np_a.transpose(1, 0, 2))
+        x = ht.ones((3, 4), split=0)
+        self.assertEqual(x.T.split, 1)
+
+    def test_tri(self):
+        np_a = np.arange(16, dtype=np.float32).reshape(4, 4)
+        for split in (None, 0, 1):
+            a = ht.array(np_a, split=split)
+            self.assert_array_equal(ht.tril(a), np.tril(np_a))
+            self.assert_array_equal(ht.triu(a, 1), np.triu(np_a, 1))
+
+    def test_norms(self):
+        np_a = np.arange(12, dtype=np.float32).reshape(3, 4) - 5
+        for split in (None, 0, 1):
+            a = ht.array(np_a, split=split)
+            self.assertAlmostEqual(float(ht.norm(a)), float(np.linalg.norm(np_a)), places=4)
+            self.assert_array_equal(ht.vector_norm(a, axis=0), np.linalg.norm(np_a, axis=0), rtol=1e-5)
+            self.assertAlmostEqual(
+                float(ht.matrix_norm(a)), float(np.linalg.norm(np_a, "fro")), places=4
+            )
+
+    def test_det_inv_trace(self):
+        np_a = np.array([[4.0, 1.0], [2.0, 3.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(np_a, split=split)
+            self.assertAlmostEqual(float(ht.det(a)), float(np.linalg.det(np_a)), places=4)
+            np.testing.assert_allclose(ht.inv(a).numpy(), np.linalg.inv(np_a), rtol=1e-5)
+        self.assertAlmostEqual(ht.trace(ht.array(np_a)), np.trace(np_a), places=5)
+
+    def test_projection_cross(self):
+        a = ht.array([1.0, 0.0, 0.0])
+        b = ht.array([1.0, 1.0, 0.0])
+        np.testing.assert_allclose(ht.linalg.projection(b, a).numpy(), [1.0, 0.0, 0.0], atol=1e-6)
+        np.testing.assert_allclose(
+            ht.linalg.cross(a, b).numpy(), np.cross([1.0, 0, 0], [1.0, 1.0, 0]), atol=1e-6
+        )
+
+
+class TestFactories(TestCase):
+    def test_factories_surface(self):
+        for split in (None, 0):
+            self.assert_array_equal(ht.zeros((4, 3), split=split), np.zeros((4, 3), np.float32))
+            self.assert_array_equal(ht.ones((4, 3), split=split), np.ones((4, 3), np.float32))
+            self.assert_array_equal(ht.full((4, 3), 7, split=split), np.full((4, 3), 7))
+            self.assert_array_equal(ht.eye(4, split=split), np.eye(4, dtype=np.float32))
+        self.assert_array_equal(ht.arange(2, 10, 2), np.arange(2, 10, 2))
+        self.assert_array_equal(ht.linspace(0, 1, 5), np.linspace(0, 1, 5, dtype=np.float32))
+        self.assert_array_equal(
+            ht.logspace(0, 2, 5), np.logspace(0, 2, 5).astype(np.float32), rtol=1e-5
+        )
+        x, step = ht.linspace(0, 1, 5, retstep=True)
+        self.assertAlmostEqual(step, 0.25)
+
+    def test_like_factories(self):
+        proto = ht.ones((3, 4), dtype=ht.int32, split=1)
+        z = ht.zeros_like(proto)
+        self.assertEqual(z.shape, (3, 4))
+        self.assertIs(z.dtype, ht.int32)
+        self.assertEqual(z.split, 1)
+        o = ht.ones_like(proto)
+        self.assertEqual(o.sum().item(), 12)
+        f = ht.full_like(proto, 5)
+        self.assertEqual(f.numpy()[0, 0], 5)
+        e = ht.empty_like(proto)
+        self.assertEqual(e.shape, (3, 4))
+
+    def test_array_ingest(self):
+        # nested sequences, numpy, jax, torch, DNDarray
+        self.assertEqual(ht.array([[1, 2], [3, 4]]).shape, (2, 2))
+        self.assertIs(ht.array([1.5]).dtype, ht.float32)
+        self.assertIs(ht.array(np.float64(1.5)).dtype, ht.float64)
+        import torch
+
+        t = torch.arange(6).reshape(2, 3)
+        x = ht.array(t, split=1)
+        self.assert_array_equal(x, t.numpy())
+        y = ht.array(x, dtype=ht.float32, split=0)
+        self.assertIs(y.dtype, ht.float32)
+        self.assertEqual(y.split, 0)
+        with self.assertRaises(ValueError):
+            ht.array([1, 2], split=0, is_split=0)
+
+    def test_is_split(self):
+        local = np.arange(6).reshape(2, 3)
+        x = ht.array(local, is_split=0)
+        self.assertEqual(x.split, 0)
+
+    def test_meshgrid(self):
+        xs = np.arange(4).astype(np.float32)
+        ys = np.arange(3).astype(np.float32)
+        hx, hy = ht.meshgrid(ht.array(xs, split=0), ht.array(ys))
+        ex, ey = np.meshgrid(xs, ys)
+        np.testing.assert_array_equal(hx.numpy(), ex)
+        np.testing.assert_array_equal(hy.numpy(), ey)
+
+    def test_asarray(self):
+        x = ht.arange(5)
+        self.assertIs(ht.asarray(x), x)
+        y = ht.asarray([1, 2, 3])
+        self.assertEqual(y.shape, (3,))
+
+
+class TestPrinting(TestCase):
+    def test_repr(self):
+        x = ht.arange(5, split=0)
+        s = repr(x)
+        self.assertIn("DNDarray", s)
+        self.assertIn("split=0", s)
+        ht.local_printing()
+        s2 = repr(x)
+        self.assertIn("local shards", s2)
+        ht.global_printing()
+        ht.set_printoptions(precision=2)
+        self.assertEqual(ht.get_printoptions()["precision"], 2)
+        ht.set_printoptions(profile="default")
