@@ -16,6 +16,7 @@ from .exponential import *
 from .relational import *
 from .logical import *
 from .complex_math import *
+from .statistics import *
 from . import linalg
 from .linalg import *  # promoted to the flat namespace like the reference
 from .version import __version__
@@ -34,6 +35,7 @@ from . import (
     relational,
     rounding,
     sanitation,
+    statistics,
     stride_tricks,
     trigonometrics,
     types,

@@ -1,0 +1,209 @@
+"""Statistics tests (reference heat/core/tests/test_statistics.py): every assertion runs
+for every split axis via the assert_func_equal split sweep."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestArgReductions(TestCase):
+    def test_argmax(self):
+        self.assert_func_equal((7, 5), ht.argmax, np.argmax, distributed_result=False)
+        self.assert_func_equal(
+            (7, 5), ht.argmax, np.argmax, heat_args={"axis": 0}, numpy_args={"axis": 0}
+        )
+        self.assert_func_equal(
+            (4, 6, 3), ht.argmax, np.argmax, heat_args={"axis": 1}, numpy_args={"axis": 1}
+        )
+        self.assert_func_equal(
+            (4, 6, 3), ht.argmax, np.argmax, heat_args={"axis": -1}, numpy_args={"axis": -1}
+        )
+
+    def test_argmin(self):
+        self.assert_func_equal((7, 5), ht.argmin, np.argmin, distributed_result=False)
+        self.assert_func_equal(
+            (7, 5), ht.argmin, np.argmin, heat_args={"axis": 1}, numpy_args={"axis": 1}
+        )
+
+    def test_argmax_split_preserved(self):
+        x = ht.array(np.arange(24).reshape(4, 6), split=0)
+        r = ht.argmax(x, axis=1)
+        self.assertEqual(r.split, 0)
+        r = ht.argmax(x, axis=0)
+        self.assertEqual(r.split, None)
+
+    def test_argmax_keepdims(self):
+        a = np.random.default_rng(0).random((3, 5))
+        x = ht.array(a, split=1)
+        self.assert_array_equal(ht.argmax(x, axis=0, keepdims=True), np.argmax(a, axis=0, keepdims=True))
+
+
+class TestMoments(TestCase):
+    def test_mean(self):
+        self.assert_func_equal((8, 6), ht.mean, np.mean, data_types=(np.float32, np.float64))
+        self.assert_func_equal(
+            (8, 6), ht.mean, np.mean, heat_args={"axis": 0}, numpy_args={"axis": 0},
+            data_types=(np.float64,),
+        )
+        self.assert_func_equal(
+            (4, 5, 6), ht.mean, np.mean, heat_args={"axis": 2}, numpy_args={"axis": 2},
+            data_types=(np.float64,),
+        )
+
+    def test_var_std(self):
+        self.assert_func_equal((9, 4), ht.var, np.var, data_types=(np.float64,))
+        self.assert_func_equal(
+            (9, 4), ht.var, np.var, heat_args={"axis": 0, "ddof": 1},
+            numpy_args={"axis": 0, "ddof": 1}, data_types=(np.float64,),
+        )
+        self.assert_func_equal((9, 4), ht.std, np.std, data_types=(np.float64,))
+        self.assert_func_equal(
+            (9, 4), ht.std, np.std, heat_args={"axis": 1}, numpy_args={"axis": 1},
+            data_types=(np.float64,),
+        )
+
+    def test_max_min(self):
+        self.assert_func_equal((7, 8), ht.max, np.max, distributed_result=False)
+        self.assert_func_equal(
+            (7, 8), ht.max, np.max, heat_args={"axis": 0}, numpy_args={"axis": 0}
+        )
+        self.assert_func_equal((7, 8), ht.min, np.min, distributed_result=False)
+        self.assert_func_equal(
+            (7, 8), ht.min, np.min, heat_args={"axis": 1}, numpy_args={"axis": 1}
+        )
+
+    def test_maximum_minimum(self):
+        rng = np.random.default_rng(3)
+        a, b = rng.random((6, 5)), rng.random((6, 5))
+        for split in (None, 0, 1):
+            x, y = ht.array(a, split=split), ht.array(b, split=split)
+            self.assert_array_equal(ht.maximum(x, y), np.maximum(a, b))
+            self.assert_array_equal(ht.minimum(x, y), np.minimum(a, b))
+
+    def test_average(self):
+        rng = np.random.default_rng(4)
+        a = rng.random((5, 7))
+        w = rng.random(7)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.average(x), np.average(a))
+            self.assert_array_equal(
+                ht.average(x, axis=1, weights=ht.array(w)), np.average(a, axis=1, weights=w)
+            )
+        r, s = ht.average(ht.array(a, split=0), axis=0, returned=True)
+        e, t = np.average(a, axis=0, returned=True)
+        self.assert_array_equal(r, e)
+        np.testing.assert_allclose(s.numpy(), t)
+
+    def test_skew_kurtosis(self):
+        rng = np.random.default_rng(5)
+        a = rng.standard_normal((40,))
+        try:
+            from scipy import stats  # noqa
+            has_scipy = True
+        except ImportError:
+            has_scipy = False
+        x = ht.array(a, split=0)
+        # against manual formulas
+        n = a.size
+        m = a.mean()
+        m2 = ((a - m) ** 2).mean()
+        m3 = ((a - m) ** 3).mean()
+        g1 = m3 / m2**1.5 * np.sqrt(n * (n - 1)) / (n - 2)
+        np.testing.assert_allclose(float(ht.skew(x).item()), g1, rtol=1e-5)
+        m4 = ((a - m) ** 4).mean()
+        g2 = m4 / m2**2
+        k = ((n - 1) / ((n - 2) * (n - 3))) * ((n + 1) * g2 - 3 * (n - 1)) + 3 - 3
+        np.testing.assert_allclose(float(ht.kurtosis(x).item()), k, rtol=1e-5)
+
+
+class TestQuantiles(TestCase):
+    def test_median(self):
+        self.assert_func_equal((9,), ht.median, np.median, data_types=(np.float64,))
+        self.assert_func_equal((6, 8), ht.median, np.median, data_types=(np.float64,))
+        self.assert_func_equal(
+            (6, 8), ht.median, np.median, heat_args={"axis": 0}, numpy_args={"axis": 0},
+            data_types=(np.float64,),
+        )
+
+    def test_percentile(self):
+        rng = np.random.default_rng(6)
+        a = rng.random((10, 6))
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.percentile(x, 30.0), np.percentile(a, 30.0))
+            self.assert_array_equal(
+                ht.percentile(x, 75.0, axis=0), np.percentile(a, 75.0, axis=0)
+            )
+            self.assert_array_equal(
+                ht.percentile(x, [25.0, 50.0, 75.0], axis=1),
+                np.percentile(a, [25.0, 50.0, 75.0], axis=1),
+            )
+
+
+class TestHistograms(TestCase):
+    def test_bincount(self):
+        a = np.array([0, 1, 1, 3, 2, 1, 7])
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.bincount(x), np.bincount(a))
+            self.assert_array_equal(ht.bincount(x, minlength=10), np.bincount(a, minlength=10))
+
+    def test_histc_histogram(self):
+        rng = np.random.default_rng(7)
+        a = rng.random(50).astype(np.float32)
+        x = ht.array(a, split=0)
+        h = ht.histc(x, bins=10)
+        expected, _ = np.histogram(a, bins=10, range=(a.min(), a.max()))
+        np.testing.assert_array_equal(h.numpy().astype(np.int64), expected)
+        hh, edges = ht.histogram(x, bins=8)
+        eh, ee = np.histogram(a, bins=8)
+        np.testing.assert_array_equal(hh.numpy(), eh)
+        np.testing.assert_allclose(edges.numpy(), ee, rtol=1e-6)
+
+    def test_digitize_bucketize(self):
+        a = np.array([0.2, 6.4, 3.0, 1.6, -1.0])
+        bins = np.array([0.0, 1.0, 2.5, 4.0, 10.0])
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.digitize(x, ht.array(bins)), np.digitize(a, bins))
+            self.assert_array_equal(
+                ht.digitize(x, ht.array(bins), right=True), np.digitize(a, bins, right=True)
+            )
+            got = ht.bucketize(x, ht.array(bins))
+            np.testing.assert_array_equal(got.numpy(), np.searchsorted(bins, a, side="left"))
+
+
+class TestCov(TestCase):
+    def test_cov(self):
+        rng = np.random.default_rng(8)
+        a = rng.random((4, 20))
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.cov(x), np.cov(a))
+            self.assert_array_equal(ht.cov(x, bias=True), np.cov(a, bias=True))
+        b = rng.random((4, 20))
+        x, y = ht.array(a, split=0), ht.array(b, split=0)
+        self.assert_array_equal(ht.cov(x, y), np.cov(a, b))
+        v = rng.random(30)
+        self.assert_array_equal(ht.cov(ht.array(v, split=0)), np.cov(v))
+
+
+class TestMethodAliases(TestCase):
+    def test_methods(self):
+        a = np.random.default_rng(9).random((6, 4))
+        x = ht.array(a, split=0)
+        self.assert_array_equal(x.mean(axis=0), a.mean(axis=0))
+        self.assert_array_equal(x.var(axis=1), a.var(axis=1))
+        self.assert_array_equal(x.std(), np.asarray(a.std()))
+        self.assert_array_equal(x.max(axis=0), a.max(axis=0))
+        self.assert_array_equal(x.min(axis=1), a.min(axis=1))
+        self.assert_array_equal(x.argmax(axis=0), np.argmax(a, axis=0))
+        self.assert_array_equal(x.median(axis=0), np.median(a, axis=0))
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
