@@ -17,6 +17,8 @@ from .relational import *
 from .logical import *
 from .complex_math import *
 from .statistics import *
+from .manipulations import *
+from .indexing import *
 from . import linalg
 from .linalg import *  # promoted to the flat namespace like the reference
 from .version import __version__
@@ -29,7 +31,9 @@ from . import (
     dndarray,
     exponential,
     factories,
+    indexing,
     logical,
+    manipulations,
     memory,
     printing,
     relational,

@@ -1,0 +1,40 @@
+"""Indexing functions (reference heat/core/indexing.py, 149 LoC)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import sanitation, types
+from .dndarray import DNDarray
+
+__all__ = ["nonzero", "where"]
+
+
+def nonzero(x: DNDarray) -> DNDarray:
+    """Indices of non-zero elements as an (n, ndim) array (reference ``indexing.py:16``,
+    torch.nonzero layout). The result is replicated — the reference gathers the per-rank
+    index lists the same way."""
+    sanitation.sanitize_in(x)
+    idx = jnp.nonzero(x.larray)
+    result = jnp.stack(idx, axis=1).astype(jnp.int64) if idx else jnp.zeros((0, 0), jnp.int64)
+    result_split = 0 if x.split is not None else None
+    out = x.comm.shard(result, result_split)
+    return DNDarray(
+        out, tuple(result.shape), types.canonical_heat_type(result.dtype), result_split,
+        x.device, x.comm, True,
+    )
+
+
+def where(cond: DNDarray, x=None, y=None) -> DNDarray:
+    """Elements chosen from ``x`` or ``y`` depending on ``cond``
+    (reference ``indexing.py:91``)."""
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y should be given")
+    from . import _operations
+
+    cv = cond.larray if isinstance(cond, DNDarray) else jnp.asarray(cond)
+    return _operations.binary_op(lambda a, b: jnp.where(cv, a, b), x, y)

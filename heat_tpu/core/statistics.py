@@ -12,6 +12,7 @@ split bookkeeping (which output dim still carries the mesh axis) survives, share
 
 from __future__ import annotations
 
+from builtins import max as builtins_max
 from typing import Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -46,6 +47,15 @@ __all__ = [
 ]
 
 
+def _handle_out(res: DNDarray, out: Optional[DNDarray], proto: DNDarray) -> DNDarray:
+    """Write ``res`` into a user-provided ``out`` buffer, casting to its dtype."""
+    if out is None:
+        return res
+    sanitation.sanitize_out(out, res.gshape, res.split, proto.device)
+    out.larray = proto.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
+    return out
+
+
 def _wrap(value, proto: DNDarray, split: Optional[int]) -> DNDarray:
     if split is not None and (value.ndim == 0 or split >= value.ndim):
         split = None
@@ -75,12 +85,7 @@ def _arg_reduce(op, x: DNDarray, axis, out, keepdims: bool) -> DNDarray:
         if keepdims:
             result = jnp.expand_dims(result, axis)
         out_split = _operations._out_split_reduce(x, axis, keepdims)
-    res = _wrap(result, x, out_split)
-    if out is not None:
-        sanitation.sanitize_out(out, res.gshape, res.split, x.device)
-        out.larray = x.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
-        return out
-    return res
+    return _handle_out(_wrap(result, x, out_split), out, x)
 
 
 def argmax(x: DNDarray, axis: Optional[int] = None, out: Optional[DNDarray] = None, keepdims: bool = False) -> DNDarray:
@@ -151,8 +156,6 @@ def bincount(x: DNDarray, weights: Optional[DNDarray] = None, minlength: int = 0
     return _wrap(result, x, None)
 
 
-builtins_max = max  # rebound below; keep a handle on the Python builtin
-
 
 def bucketize(input: DNDarray, boundaries, out_int32: bool = False, right: bool = False, out=None) -> DNDarray:
     """Index of the bucket each element falls into (reference ``statistics.py:289``,
@@ -164,12 +167,7 @@ def bucketize(input: DNDarray, boundaries, out_int32: bool = False, right: bool 
     # right=False means v <= boundary ⇒ numpy searchsorted side='left'
     result = jnp.searchsorted(b, input.larray.reshape(-1), side=side).reshape(input.gshape)
     result = result.astype(jnp.int32 if out_int32 else jnp.int64)
-    res = _wrap(result, input, input.split)
-    if out is not None:
-        sanitation.sanitize_out(out, res.gshape, res.split, input.device)
-        out.larray = input.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
-        return out
-    return res
+    return _handle_out(_wrap(result, input, input.split), out, input)
 
 
 def cov(m: DNDarray, y: Optional[DNDarray] = None, rowvar: bool = True, bias: bool = False, ddof: Optional[int] = None) -> DNDarray:
@@ -184,6 +182,8 @@ def cov(m: DNDarray, y: Optional[DNDarray] = None, rowvar: bool = True, bias: bo
         x = x.T
     if y is not None:
         yv = y.larray if isinstance(y, DNDarray) else jnp.asarray(y)
+        if yv.ndim > 2:
+            raise ValueError("y has more than 2 dimensions")
         if yv.ndim == 1:
             yv = yv.reshape(1, -1)
         if not rowvar and yv.shape[0] != 1:
@@ -219,17 +219,14 @@ def histc(input: DNDarray, bins: int = 100, min: float = 0.0, max: float = 0.0, 
         lo, hi = float(jnp.min(data)), float(jnp.max(data))
     hist, _ = jnp.histogram(data, bins=bins, range=(lo, hi))
     result = hist.astype(input.larray.dtype)
-    res = _wrap(result, input, None)
-    if out is not None:
-        sanitation.sanitize_out(out, res.gshape, None, input.device)
-        out.larray = input.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
-        return out
-    return res
+    return _handle_out(_wrap(result, input, None), out, input)
 
 
 def histogram(a: DNDarray, bins: int = 10, range=None, normed=None, weights=None, density=None):
     """numpy-compatible histogram (reference ``statistics.py:522``)."""
     sanitation.sanitize_in(a)
+    if normed is not None:
+        raise NotImplementedError("'normed' is deprecated; use density instead")
     w = weights.larray.reshape(-1) if isinstance(weights, DNDarray) else weights
     hist, edges = jnp.histogram(a.larray.reshape(-1), bins=bins, range=range, weights=w, density=density)
     return _wrap(hist, a, None), _wrap(edges, a, None)
@@ -318,12 +315,7 @@ def percentile(
     out_split = _operations._out_split_reduce(x, axis_s, keepdims) if axis_s is not None else None
     if out_split is not None and np.ndim(q):  # leading q dim shifts the split
         out_split += np.ndim(q)
-    res = _wrap(result, x, out_split)
-    if out is not None:
-        sanitation.sanitize_out(out, res.gshape, res.split, x.device)
-        out.larray = x.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
-        return out
-    return res
+    return _handle_out(_wrap(result, x, out_split), out, x)
 
 
 def skew(x: DNDarray, axis: Optional[int] = None, unbiased: bool = True) -> DNDarray:

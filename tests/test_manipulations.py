@@ -1,0 +1,251 @@
+"""Manipulation tests (reference heat/core/tests/test_manipulations.py, 3753 LoC):
+split-sweep parity against numpy for the reshape layer."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestShapeOps(TestCase):
+    def test_reshape(self):
+        a = np.arange(24).astype(np.float32)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.reshape(x, (4, 6)), a.reshape(4, 6))
+            self.assert_array_equal(ht.reshape(x, (2, 3, 4)), a.reshape(2, 3, 4))
+            self.assert_array_equal(ht.reshape(x, (4, -1)), a.reshape(4, 6))
+        x = ht.array(a.reshape(4, 6), split=1)
+        r = ht.reshape(x, (6, 4), new_split=0)
+        self.assertEqual(r.split, 0)
+        self.assert_array_equal(r, a.reshape(6, 4))
+        with self.assertRaises(ValueError):
+            ht.reshape(ht.array(a), (5, 5))
+
+    def test_flatten_ravel(self):
+        a = np.arange(24).reshape(2, 3, 4)
+        for split in (None, 0, 1, 2):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.flatten(x), a.flatten())
+            self.assert_array_equal(ht.ravel(x), a.ravel())
+            if split is not None:
+                self.assertEqual(ht.flatten(x).split, 0)
+
+    def test_squeeze_expand_dims(self):
+        a = np.arange(12).reshape(1, 3, 1, 4)
+        for split in (None, 1, 3):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.squeeze(x), np.squeeze(a))
+            self.assert_array_equal(ht.squeeze(x, axis=0), np.squeeze(a, axis=0))
+        x = ht.array(np.arange(6).reshape(2, 3), split=1)
+        e = ht.expand_dims(x, 0)
+        self.assertEqual(e.split, 2)
+        self.assert_array_equal(e, np.expand_dims(np.arange(6).reshape(2, 3), 0))
+        with self.assertRaises(ValueError):
+            ht.squeeze(x, axis=0)
+
+    def test_broadcast(self):
+        a = np.arange(6).reshape(2, 3).astype(np.float64)
+        x = ht.array(a, split=0)
+        b = ht.broadcast_to(x, (4, 2, 3))
+        self.assertEqual(b.split, 1)
+        self.assert_array_equal(b, np.broadcast_to(a, (4, 2, 3)))
+        arrs = ht.broadcast_arrays(ht.array(np.arange(3.0)), x)
+        self.assert_array_equal(arrs[0], np.broadcast_to(np.arange(3.0), (2, 3)))
+        self.assert_array_equal(arrs[1], a)
+
+
+class TestJoinSplit(TestCase):
+    def test_concatenate(self):
+        rng = np.random.default_rng(0)
+        a, b = rng.random((4, 5)), rng.random((3, 5))
+        for split in (None, 0, 1):
+            x, y = ht.array(a, split=split), ht.array(b, split=split)
+            r = ht.concatenate([x, y], axis=0)
+            self.assert_array_equal(r, np.concatenate([a, b], axis=0))
+            self.assertEqual(r.split, split)
+        c = rng.random((4, 2))
+        self.assert_array_equal(
+            ht.concatenate([ht.array(a, split=0), ht.array(c, split=0)], axis=1),
+            np.concatenate([a, c], axis=1),
+        )
+        # mixed dtypes promote
+        ai = np.arange(4).reshape(2, 2)
+        af = np.arange(4.0).reshape(2, 2)
+        r = ht.concatenate([ht.array(ai), ht.array(af)], axis=0)
+        self.assertEqual(r.dtype, ht.float64)
+
+    def test_stack_hstack_vstack(self):
+        rng = np.random.default_rng(1)
+        a, b = rng.random((3, 4)), rng.random((3, 4))
+        for split in (None, 0, 1):
+            x, y = ht.array(a, split=split), ht.array(b, split=split)
+            s = ht.stack([x, y], axis=0)
+            self.assert_array_equal(s, np.stack([a, b]))
+            if split is not None:
+                self.assertEqual(s.split, split + 1)
+            self.assert_array_equal(ht.vstack([x, y]), np.vstack([a, b]))
+            self.assert_array_equal(ht.hstack([x, y]), np.hstack([a, b]))
+            self.assert_array_equal(ht.row_stack([x, y]), np.vstack([a, b]))
+            self.assert_array_equal(ht.column_stack([x, y]), np.column_stack([a, b]))
+        v1, v2 = rng.random(5), rng.random(5)
+        self.assert_array_equal(ht.hstack([ht.array(v1, split=0), ht.array(v2, split=0)]), np.hstack([v1, v2]))
+        self.assert_array_equal(ht.column_stack([ht.array(v1), ht.array(v2)]), np.column_stack([v1, v2]))
+
+    def test_split_family(self):
+        a = np.arange(24.0).reshape(4, 6)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            for got, exp in zip(ht.split(x, 2, axis=0), np.split(a, 2, axis=0)):
+                self.assert_array_equal(got, exp)
+            for got, exp in zip(ht.hsplit(x, 3), np.hsplit(a, 3)):
+                self.assert_array_equal(got, exp)
+            for got, exp in zip(ht.vsplit(x, 2), np.vsplit(a, 2)):
+                self.assert_array_equal(got, exp)
+        b = np.arange(24.0).reshape(2, 3, 4)
+        for got, exp in zip(ht.dsplit(ht.array(b, split=0), 2), np.dsplit(b, 2)):
+            self.assert_array_equal(got, exp)
+        for got, exp in zip(ht.split(x, [1, 3], axis=0), np.split(a, [1, 3], axis=0)):
+            self.assert_array_equal(got, exp)
+
+
+class TestReorder(TestCase):
+    def test_flip_roll_rot90(self):
+        a = np.arange(24.0).reshape(4, 6)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.flip(x), np.flip(a))
+            self.assert_array_equal(ht.flip(x, 0), np.flip(a, 0))
+            self.assert_array_equal(ht.fliplr(x), np.fliplr(a))
+            self.assert_array_equal(ht.flipud(x), np.flipud(a))
+            self.assert_array_equal(ht.roll(x, 2), np.roll(a, 2))
+            self.assert_array_equal(ht.roll(x, 1, axis=0), np.roll(a, 1, axis=0))
+            self.assert_array_equal(ht.roll(x, (1, 2), axis=(0, 1)), np.roll(a, (1, 2), axis=(0, 1)))
+            self.assert_array_equal(ht.rot90(x), np.rot90(a))
+            self.assert_array_equal(ht.rot90(x, k=2), np.rot90(a, k=2))
+
+    def test_moveaxis_swapaxes(self):
+        a = np.arange(24.0).reshape(2, 3, 4)
+        for split in (None, 0, 1, 2):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.moveaxis(x, 0, 2), np.moveaxis(a, 0, 2))
+            self.assert_array_equal(ht.swapaxes(x, 0, 1), np.swapaxes(a, 0, 1))
+
+    def test_sort(self):
+        rng = np.random.default_rng(2)
+        a = rng.random((5, 7))
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            v, i = ht.sort(x, axis=1)
+            self.assert_array_equal(v, np.sort(a, axis=1))
+            np.testing.assert_array_equal(i.numpy(), np.argsort(a, axis=1))
+            v, i = ht.sort(x, axis=0, descending=True)
+            self.assert_array_equal(v, -np.sort(-a, axis=0))
+
+    def test_topk(self):
+        rng = np.random.default_rng(3)
+        a = rng.random((4, 9))
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            v, i = ht.topk(x, 3)
+            exp = -np.sort(-a, axis=1)[:, :3]
+            self.assert_array_equal(v, exp)
+            np.testing.assert_array_equal(np.take_along_axis(a, i.numpy(), axis=1), exp)
+            v, i = ht.topk(x, 2, largest=False)
+            self.assert_array_equal(v, np.sort(a, axis=1)[:, :2])
+
+    def test_unique(self):
+        a = np.array([[3, 2], [1, 3]])
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.unique(x, sorted=True), np.unique(a))
+            r, inv = ht.unique(x, sorted=True, return_inverse=True)
+            er, einv = np.unique(a, return_inverse=True)
+            self.assert_array_equal(r, er)
+            np.testing.assert_array_equal(inv.numpy().reshape(-1), einv.reshape(-1))
+        b = np.array([[1, 2], [1, 2], [3, 4]])
+        self.assert_array_equal(ht.unique(ht.array(b, split=0), sorted=True, axis=0), np.unique(b, axis=0))
+
+
+class TestDiagPad(TestCase):
+    def test_diag_diagonal(self):
+        a = np.arange(5.0)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.diag(x), np.diag(a))
+            self.assert_array_equal(ht.diag(x, offset=1), np.diag(a, k=1))
+        m = np.arange(20.0).reshape(4, 5)
+        for split in (None, 0, 1):
+            x = ht.array(m, split=split)
+            self.assert_array_equal(ht.diag(x), np.diag(m))
+            self.assert_array_equal(ht.diagonal(x, offset=1), np.diagonal(m, offset=1))
+        t = np.arange(24.0).reshape(2, 3, 4)
+        x = ht.array(t, split=2)
+        d = ht.diagonal(x, dim1=0, dim2=1)
+        self.assert_array_equal(d, np.diagonal(t, axis1=0, axis2=1))
+        self.assertEqual(d.split, 0)
+
+    def test_pad(self):
+        a = np.arange(12.0).reshape(3, 4)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.pad(x, 1), np.pad(a, 1))
+            self.assert_array_equal(
+                ht.pad(x, ((1, 2), (0, 3)), constant_values=5.0),
+                np.pad(a, ((1, 2), (0, 3)), constant_values=5.0),
+            )
+            self.assert_array_equal(ht.pad(x, 2, mode="edge"), np.pad(a, 2, mode="edge"))
+
+    def test_repeat_tile(self):
+        a = np.arange(6.0).reshape(2, 3)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.repeat(x, 2), np.repeat(a, 2))
+            self.assert_array_equal(ht.repeat(x, 3, axis=1), np.repeat(a, 3, axis=1))
+            self.assert_array_equal(ht.tile(x, (2, 2)), np.tile(a, (2, 2)))
+            self.assert_array_equal(ht.tile(x, (2, 1, 3)), np.tile(a, (2, 1, 3)))
+
+
+class TestDistributionVerbs(TestCase):
+    def test_resplit_collect_balance(self):
+        a = np.arange(24.0).reshape(4, 6)
+        x = ht.array(a, split=0)
+        y = ht.resplit(x, 1)
+        self.assertEqual(y.split, 1)
+        self.assertEqual(x.split, 0)  # out-of-place
+        self.assert_array_equal(y, a)
+        z = ht.collect(x)
+        self.assertIsNone(z.split)
+        self.assert_array_equal(z, a)
+        self.assert_array_equal(ht.balance(x, copy=True), a)
+        r = ht.redistribute(x)
+        self.assert_array_equal(r, a)
+
+    def test_shape(self):
+        x = ht.array(np.zeros((3, 4)), split=1)
+        self.assertEqual(ht.manipulations.shape(x), (3, 4))
+
+
+class TestIndexingModule(TestCase):
+    def test_nonzero(self):
+        a = np.array([[1, 0, 2], [0, 0, 3]])
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            got = ht.nonzero(x)
+            exp = np.stack(np.nonzero(a), axis=1)
+            np.testing.assert_array_equal(got.numpy(), exp)
+
+    def test_where(self):
+        a = np.array([[1.0, -2.0], [-3.0, 4.0]])
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            r = ht.where(x > 0, x, 0.0)
+            self.assert_array_equal(r, np.where(a > 0, a, 0.0))
+        got = ht.where(ht.array(a, split=0) > 0)
+        np.testing.assert_array_equal(got.numpy(), np.stack(np.nonzero(a > 0), axis=1))
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
