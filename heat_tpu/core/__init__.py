@@ -19,6 +19,8 @@ from .complex_math import *
 from .statistics import *
 from .manipulations import *
 from .indexing import *
+from .signal import *
+from . import random
 from . import linalg
 from .linalg import *  # promoted to the flat namespace like the reference
 from .version import __version__
@@ -36,9 +38,11 @@ from . import (
     manipulations,
     memory,
     printing,
+    random,
     relational,
     rounding,
     sanitation,
+    signal,
     statistics,
     stride_tricks,
     trigonometrics,

@@ -30,7 +30,7 @@ from .devices import get_device
 from .dndarray import DNDarray
 from .stride_tricks import broadcast_shapes, sanitize_axis
 
-__all__ = ["binary_op", "local_op", "reduce_op", "cum_op"]
+__all__ = ["binary_op", "local_op", "reduce_op", "cum_op", "wrap_result", "handle_out"]
 
 Scalar = (int, float, bool, complex, np.number, np.bool_)
 
@@ -41,6 +41,32 @@ def _ensure_dndarray(x, device=None, comm=None) -> DNDarray:
     if isinstance(x, DNDarray):
         return x
     return factories.array(x, device=device, comm=comm)
+
+
+def wrap_result(value, proto: DNDarray, split: Optional[int]) -> DNDarray:
+    """Wrap a raw jax value in a DNDarray with ``proto``'s device/comm, normalising an
+    out-of-range split to None and laying the value out accordingly."""
+    if split is not None and (value.ndim == 0 or split >= value.ndim or split < 0):
+        split = None
+    value = proto.comm.shard(value, split)
+    return DNDarray(
+        value,
+        tuple(value.shape),
+        types.canonical_heat_type(value.dtype),
+        split,
+        proto.device,
+        proto.comm,
+        True,
+    )
+
+
+def handle_out(res: DNDarray, out: Optional[DNDarray], proto: DNDarray) -> DNDarray:
+    """Write ``res`` into a user-provided ``out`` buffer, casting to its dtype."""
+    if out is None:
+        return res
+    sanitation.sanitize_out(out, res.gshape, res.split, proto.device)
+    out.larray = proto.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
+    return out
 
 
 def _out_split_binary(out_shape: Tuple[int, ...], *operands: DNDarray) -> Optional[int]:

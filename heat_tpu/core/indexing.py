@@ -34,7 +34,22 @@ def where(cond: DNDarray, x=None, y=None) -> DNDarray:
         return nonzero(cond)
     if x is None or y is None:
         raise TypeError("either both or neither of x and y should be given")
-    from . import _operations
+    from . import _operations, stride_tricks
 
-    cv = cond.larray if isinstance(cond, DNDarray) else jnp.asarray(cond)
-    return _operations.binary_op(lambda a, b: jnp.where(cv, a, b), x, y)
+    proto = next((t for t in (cond, x, y) if isinstance(t, DNDarray)), None)
+    if proto is None:
+        from . import factories
+
+        cond = factories.array(cond)
+        proto = cond
+
+    def val(t):
+        return t.larray if isinstance(t, DNDarray) else jnp.asarray(t)
+
+    cv, xv, yv = val(cond), val(x), val(y)
+    result = jnp.where(cv, xv, yv)
+    out_shape = tuple(result.shape)
+    # dominant-split rule over all three operands, shifted into the output rank
+    operands = [t for t in (cond, x, y) if isinstance(t, DNDarray)]
+    split = _operations._out_split_binary(out_shape, *operands)
+    return _operations.wrap_result(result, proto, split)

@@ -14,6 +14,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from . import _operations, sanitation, stride_tricks, types
@@ -62,19 +63,8 @@ __all__ = [
 ]
 
 
-def _wrap(value, proto: DNDarray, split: Optional[int]) -> DNDarray:
-    if split is not None and (value.ndim == 0 or split >= value.ndim or split < 0):
-        split = None
-    value = proto.comm.shard(value, split)
-    return DNDarray(
-        value,
-        tuple(value.shape),
-        types.canonical_heat_type(value.dtype),
-        split,
-        proto.device,
-        proto.comm,
-        True,
-    )
+_wrap = _operations.wrap_result
+_handle_out = _operations.handle_out
 
 
 def _ensure(x) -> DNDarray:
@@ -130,7 +120,7 @@ def column_stack(arrays: Sequence[DNDarray]) -> DNDarray:
 def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     """Join arrays along an existing axis (reference ``manipulations.py:391``; the
     split-matching resplit machinery there is handled by XLA's layout solver)."""
-    if len(arrays) < 2 and not isinstance(arrays, (tuple, list)):
+    if not isinstance(arrays, (tuple, list)):
         raise TypeError("concatenate requires a sequence of DNDarrays")
     arrays = [_ensure(a) for a in arrays]
     proto = arrays[0]
@@ -367,11 +357,7 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     indices = jnp.argsort(a.larray, axis=axis, descending=descending).astype(jnp.int64)
     v = _wrap(values, a, a.split)
     i = _wrap(indices, a, a.split)
-    if out is not None:
-        sanitation.sanitize_out(out, v.gshape, v.split, a.device)
-        out.larray = a.comm.shard(v.larray.astype(out.dtype.jax_type()), out.split)
-        return out, i
-    return v, i
+    return _handle_out(v, out, a), i
 
 
 def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
@@ -392,7 +378,7 @@ def squeeze(x: DNDarray, axis: Optional[Union[int, Tuple[int, ...]]] = None) -> 
     if axis is None:
         removed = tuple(i for i, s in enumerate(x.gshape) if s == 1)
     else:
-        axes = axis if isinstance(axis, tuple) else (axis,)
+        axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
         removed = tuple(sanitize_axis(x.gshape, ax) for ax in axes)
         for ax in removed:
             if x.gshape[ax] != 1:
@@ -418,12 +404,7 @@ def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
     result = jnp.stack([a.larray for a in arrays], axis=axis)
     base_split = next((a.split for a in arrays if a.split is not None), None)
     split = None if base_split is None else (base_split if base_split < axis else base_split + 1)
-    res = _wrap(result, proto, split)
-    if out is not None:
-        sanitation.sanitize_out(out, res.gshape, res.split, proto.device)
-        out.larray = proto.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
-        return out
-    return res
+    return _handle_out(_wrap(result, proto, split), out, proto)
 
 
 def swapaxes(x: DNDarray, axis1: int, axis2: int) -> DNDarray:
@@ -462,19 +443,21 @@ def topk(
     a global top-k XLA lowers directly)."""
     sanitation.sanitize_in(a)
     dim = sanitize_axis(a.gshape, dim)
-    x = a.larray
-    order = jnp.argsort(x, axis=dim, descending=largest).astype(jnp.int64)
-    idx = jnp.take(order, jnp.arange(k), axis=dim)
-    values = jnp.take_along_axis(x, idx, axis=dim)
+    if k > a.gshape[dim]:
+        raise ValueError(f"selected index k={k} out of range for dimension of size {a.gshape[dim]}")
+    x = jnp.moveaxis(a.larray, dim, -1)
+    if largest:
+        values, idx = jax.lax.top_k(x, k)
+    else:
+        neg_values, idx = jax.lax.top_k(-x.astype(jnp.promote_types(x.dtype, jnp.int32)) if x.dtype == jnp.bool_ else -x, k)
+        values = jnp.take_along_axis(x, idx, axis=-1)
+    values = jnp.moveaxis(values, -1, dim)
+    idx = jnp.moveaxis(idx.astype(jnp.int64), -1, dim)
     split = a.split if a.split != dim else None
     v, i = _wrap(values, a, split), _wrap(idx, a, split)
     if out is not None:
         out_v, out_i = out
-        sanitation.sanitize_out(out_v, v.gshape, v.split, a.device)
-        sanitation.sanitize_out(out_i, i.gshape, i.split, a.device)
-        out_v.larray = a.comm.shard(v.larray.astype(out_v.dtype.jax_type()), out_v.split)
-        out_i.larray = a.comm.shard(i.larray, out_i.split)
-        return out_v, out_i
+        return _handle_out(v, out_v, a), _handle_out(i, out_i, a)
     return v, i
 
 

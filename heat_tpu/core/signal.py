@@ -1,0 +1,47 @@
+"""Signal processing (reference heat/core/signal.py, 211 LoC).
+
+The reference's distributed 1-D ``convolve`` pads, computes a halo size from the kernel's
+local shape, exchanges halos with neighbouring ranks (``signal.py:107-120``, via
+``DNDarray.get_halo``), and runs a local ``torch.conv1d`` per rank. On TPU the signal is
+one global sharded array: a single ``jnp.convolve`` computes the same thing and XLA emits
+the boundary collective-permutes the halo exchange hand-wrote.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import types
+from .dndarray import DNDarray
+
+__all__ = ["convolve"]
+
+
+def convolve(a, v, mode: str = "full") -> DNDarray:
+    """Discrete linear convolution of two 1-D arrays (reference ``signal.py:16``)."""
+    from . import factories
+
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if not isinstance(v, DNDarray):
+        v = factories.array(v, comm=a.comm)
+    if a.ndim != 1 or v.ndim != 1:
+        raise ValueError("convolve requires 1-D inputs")
+    if mode not in ("full", "same", "valid"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    if mode == "same" and v.gshape[0] % 2 == 0:
+        raise ValueError("mode 'same' is not supported for even-sized filter weights")
+    if a.gshape[0] < v.gshape[0]:
+        a, v = v, a
+    dt = types.promote_types(a.dtype, v.dtype)
+    result = jnp.convolve(
+        a.larray.astype(dt.jax_type()), v.larray.astype(dt.jax_type()), mode=mode
+    )
+    split = a.split
+    out = a.comm.shard(result, split)
+    return DNDarray(
+        out, tuple(result.shape), types.canonical_heat_type(result.dtype), split,
+        a.device, a.comm, True,
+    )

@@ -47,28 +47,8 @@ __all__ = [
 ]
 
 
-def _handle_out(res: DNDarray, out: Optional[DNDarray], proto: DNDarray) -> DNDarray:
-    """Write ``res`` into a user-provided ``out`` buffer, casting to its dtype."""
-    if out is None:
-        return res
-    sanitation.sanitize_out(out, res.gshape, res.split, proto.device)
-    out.larray = proto.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
-    return out
-
-
-def _wrap(value, proto: DNDarray, split: Optional[int]) -> DNDarray:
-    if split is not None and (value.ndim == 0 or split >= value.ndim):
-        split = None
-    value = proto.comm.shard(value, split)
-    return DNDarray(
-        value,
-        tuple(value.shape),
-        types.canonical_heat_type(value.dtype),
-        split,
-        proto.device,
-        proto.comm,
-        True,
-    )
+_wrap = _operations.wrap_result
+_handle_out = _operations.handle_out
 
 
 def _arg_reduce(op, x: DNDarray, axis, out, keepdims: bool) -> DNDarray:

@@ -1,0 +1,51 @@
+"""Signal tests (reference heat/core/tests/test_signal.py)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestConvolve(TestCase):
+    def test_convolve_modes(self):
+        sig = np.ones(10, dtype=np.float32)
+        ker = np.arange(3, dtype=np.float32)
+        for split_a in (None, 0):
+            for split_v in (None, 0):
+                a = ht.array(sig, split=split_a)
+                v = ht.array(ker, split=split_v)
+                for mode in ("full", "same", "valid"):
+                    self.assert_array_equal(
+                        ht.convolve(a, v, mode=mode), np.convolve(sig, ker, mode=mode)
+                    )
+
+    def test_convolve_random(self):
+        rng = np.random.default_rng(0)
+        sig = rng.random(23)
+        ker = rng.random(5)
+        a, v = ht.array(sig, split=0), ht.array(ker)
+        for mode in ("full", "same", "valid"):
+            self.assert_array_equal(ht.convolve(a, v, mode=mode), np.convolve(sig, ker, mode=mode))
+
+    def test_swap_and_errors(self):
+        # kernel longer than signal swaps (numpy does the same)
+        sig, ker = np.ones(3), np.arange(7.0)
+        self.assert_array_equal(ht.convolve(ht.array(sig), ht.array(ker)), np.convolve(sig, ker))
+        with self.assertRaises(ValueError):
+            ht.convolve(ht.ones((3, 3)), ht.ones(3))
+        with self.assertRaises(ValueError):
+            ht.convolve(ht.ones(10), ht.ones(4), mode="same")
+        with self.assertRaises(ValueError):
+            ht.convolve(ht.ones(10), ht.ones(3), mode="bogus")
+
+    def test_int_promotion(self):
+        a = np.arange(8)
+        v = np.array([1, 2, 1])
+        r = ht.convolve(ht.array(a, split=0), ht.array(v))
+        self.assert_array_equal(r, np.convolve(a, v))
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
