@@ -16,6 +16,12 @@ import jax as _jax
 # *default* float stays float32 — factories pass explicit dtypes everywhere.
 _jax.config.update("jax_enable_x64", True)
 
+# The reference computes every matmul in full fp32/fp64 (torch on CPU/GPU). TPU MXUs
+# default to bf16-precision passes; "highest" restores fp32 accumulation for numerics
+# parity. Perf-critical callers opt down locally via jax.default_matmul_precision.
+_jax.config.update("jax_default_matmul_precision", "highest")
+
 from .core import *
 from .core import __version__
 from . import core
+from . import utils

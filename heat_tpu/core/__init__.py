@@ -20,6 +20,7 @@ from .statistics import *
 from .manipulations import *
 from .indexing import *
 from .signal import *
+from .tiling import *
 from . import random
 from . import linalg
 from .linalg import *  # promoted to the flat namespace like the reference
@@ -45,6 +46,7 @@ from . import (
     signal,
     statistics,
     stride_tricks,
+    tiling,
     trigonometrics,
     types,
     version,

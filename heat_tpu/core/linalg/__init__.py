@@ -1,6 +1,16 @@
 """Distributed linear algebra (reference heat/core/linalg/)."""
 
-from .basics import *
-from . import basics
+from . import basics, solver, svdtools
+from . import qr as _qr_mod
+from . import svd as _svd_mod
 
-__all__ = list(basics.__all__)
+from .basics import *
+from .qr import *
+from .solver import *
+from .svd import *
+from .svdtools import *
+
+__all__ = (
+    list(basics.__all__) + list(_qr_mod.__all__) + list(solver.__all__)
+    + list(_svd_mod.__all__) + list(svdtools.__all__)
+)

@@ -1,0 +1,18 @@
+"""Full SVD (reference heat/core/linalg/svd.py, 17 LoC).
+
+The reference intentionally raises: "Full SVD computation is not supported in heat. Please
+use heat.linalg.hsvd_rank or heat.linalg.hsvd_rtol" (``svd.py:15``). Kept for parity —
+the truncated hierarchical SVD in :mod:`.svdtools` is the supported path.
+"""
+
+from ..dndarray import DNDarray
+
+__all__ = ["svd"]
+
+
+def svd(A: DNDarray):
+    """Raises NotImplementedError, matching the reference (``svd.py:15``)."""
+    raise NotImplementedError(
+        "Full SVD computation is not supported. "
+        "Please use hsvd_rank or hsvd_rtol to compute an approximate truncated SVD."
+    )

@@ -122,6 +122,8 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     split-matching resplit machinery there is handled by XLA's layout solver)."""
     if not isinstance(arrays, (tuple, list)):
         raise TypeError("concatenate requires a sequence of DNDarrays")
+    if len(arrays) == 0:
+        raise ValueError("need at least one array to concatenate")
     arrays = [_ensure(a) for a in arrays]
     proto = arrays[0]
     axis = sanitize_axis(proto.gshape, axis)
@@ -449,7 +451,9 @@ def topk(
     if largest:
         values, idx = jax.lax.top_k(x, k)
     else:
-        neg_values, idx = jax.lax.top_k(-x.astype(jnp.promote_types(x.dtype, jnp.int32)) if x.dtype == jnp.bool_ else -x, k)
+        # negation overflows INT_MIN and wraps unsigned dtypes; an ascending argsort is
+        # always order-correct for the smallest-k path
+        idx = jnp.argsort(x, axis=-1)[..., :k]
         values = jnp.take_along_axis(x, idx, axis=-1)
     values = jnp.moveaxis(values, -1, dim)
     idx = jnp.moveaxis(idx.astype(jnp.int64), -1, dim)

@@ -57,7 +57,11 @@ def _next_key(nelem: int) -> jax.Array:
     count — the property that makes streams independent of the device count
     (reference ``__counter_sequence`` ``random.py:56``)."""
     global __counter
-    key = jax.random.fold_in(jax.random.key(__seed), __counter % (2**31))
+    # fold the counter in 32-bit limbs so the stream never wraps (the reference's
+    # Threefry counter is effectively 128-bit, random.py:56)
+    lo = __counter & 0xFFFFFFFF
+    hi = (__counter >> 32) & 0xFFFFFFFF
+    key = jax.random.fold_in(jax.random.fold_in(jax.random.key(__seed), hi), lo)
     __counter += int(nelem)
     return key
 
@@ -119,6 +123,8 @@ def permutation(x: Union[int, DNDarray], **kwargs) -> DNDarray:
         return randperm(x, **kwargs)
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected int or DNDarray, got {type(x)}")
+    if kwargs:
+        raise TypeError(f"unexpected kwargs {tuple(kwargs)} for DNDarray input")
     key = _next_key(x.gshape[0])
     perm = jax.random.permutation(key, x.gshape[0])
     result = jnp.take(x.larray, perm, axis=0)

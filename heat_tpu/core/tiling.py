@@ -1,0 +1,204 @@
+"""Tile decompositions (reference heat/core/tiling.py, 1250 LoC).
+
+The reference uses ``SplitTiles`` to drive ``resplit_``'s tile-wise Isend/Irecv and
+``SquareDiagTiles`` to schedule the tiled QR. On TPU neither is needed for data movement
+(XLA owns layout changes), but the tile *views* remain useful for algorithms and for API
+parity: both classes here index into the global ``jax.Array`` with the same tile grids
+the reference computes from lshape maps.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .dndarray import DNDarray
+
+__all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+class SplitTiles:
+    """Tiles by the canonical chunking along every axis (reference ``tiling.py:15``):
+    axis ``i`` is cut at the chunk boundaries the communicator assigns to axis ``i``, so
+    the grid has ``comm.size`` slots per dimension."""
+
+    def __init__(self, arr: DNDarray):
+        self.__arr = arr
+        comm = arr.comm
+        # tile_dims[d, r] = extent of tile r along dim d (reference tile_dims :109-177)
+        dims = np.zeros((arr.ndim, comm.size), dtype=np.int64)
+        for d in range(arr.ndim):
+            for r in range(comm.size):
+                _, lshape, _ = comm.chunk(arr.gshape, d, rank=r)
+                dims[d, r] = lshape[d]
+        self.__tile_dims = dims
+        ends = dims.cumsum(axis=1)
+        self.__tile_ends = ends
+        # tile_locations[tile_index along split] = owning rank
+        locs = np.arange(comm.size, dtype=np.int64)
+        self.__tile_locations = locs
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tile_dimensions(self) -> np.ndarray:
+        return self.__tile_dims
+
+    @property
+    def tile_ends_g(self) -> np.ndarray:
+        return self.__tile_ends
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        """Owning shard of each tile along the split axis (reference ``:96``)."""
+        return self.__tile_locations
+
+    def _tile_slices(self, key) -> Tuple[slice, ...]:
+        if not isinstance(key, tuple):
+            key = (key,)
+        slices = []
+        for d in range(self.__arr.ndim):
+            if d < len(key) and key[d] is not None and key[d] is not Ellipsis:
+                t = int(key[d])
+                start = 0 if t == 0 else int(self.__tile_ends[d, t - 1])
+                end = int(self.__tile_ends[d, t])
+                slices.append(slice(start, end))
+            else:
+                slices.append(slice(None))
+        return tuple(slices)
+
+    def __getitem__(self, key):
+        """View of the requested tile of the global value (reference ``:180``)."""
+        return self.__arr.larray[self._tile_slices(key)]
+
+    def __setitem__(self, key, value) -> None:
+        sl = self._tile_slices(key)
+        new = self.__arr.larray.at[sl].set(jnp.asarray(value, self.__arr.larray.dtype))
+        self.__arr.larray = self.__arr.comm.shard(new, self.__arr.split)
+
+
+class SquareDiagTiles:
+    """Tile grid with square tiles on the diagonal, the decomposition behind tiled QR
+    (reference ``tiling.py:330``). ``tiles_per_proc`` splits each shard's rows into that
+    many tile rows; column cuts mirror the row cuts so diagonal tiles are square."""
+
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 2):
+        if not isinstance(arr, DNDarray):
+            raise TypeError(f"arr must be a DNDarray, got {type(arr)}")
+        if arr.ndim != 2:
+            raise ValueError("SquareDiagTiles requires a 2-D DNDarray")
+        if tiles_per_proc < 1:
+            raise ValueError("tiles_per_proc must be >= 1")
+        self.__arr = arr
+        comm = arr.comm
+        m, n = arr.gshape
+        split = arr.split if arr.split is not None else 0
+
+        # row cuts: each shard's chunk split into tiles_per_proc near-equal pieces
+        row_cuts: List[int] = []
+        for r in range(comm.size if arr.split is not None else 1):
+            start, lshape, _ = comm.chunk(arr.gshape, split, rank=r)
+            extent = lshape[split]
+            base = extent // tiles_per_proc
+            rem = extent % tiles_per_proc
+            for t in range(tiles_per_proc):
+                row_cuts.append(base + (1 if t < rem else 0))
+        row_cuts = [c for c in row_cuts if c > 0]
+        if not row_cuts:
+            row_cuts = [m]
+        # column cuts mirror row cuts up to n (square diagonal tiles), remainder appended
+        col_cuts: List[int] = []
+        acc = 0
+        for c in row_cuts:
+            if acc + c <= n:
+                col_cuts.append(c)
+                acc += c
+            elif n - acc > 0:
+                col_cuts.append(n - acc)
+                acc = n
+        if acc < n:
+            col_cuts.append(n - acc)
+
+        self.__row_per_proc_list = [tiles_per_proc] * comm.size
+        self.__tile_rows_per_process = [tiles_per_proc] * comm.size
+        self.__row_inds = list(np.cumsum([0] + row_cuts))[:-1]
+        self.__col_inds = list(np.cumsum([0] + col_cuts))[:-1]
+        self.__row_cuts = row_cuts
+        self.__col_cuts = col_cuts
+        # tile_map[i, j] = owning rank of tile (i, j) (reference tile_map :772)
+        tmap = np.zeros((len(row_cuts), len(col_cuts)), dtype=np.int64)
+        if arr.split == 0 or arr.split is None:
+            for i in range(len(row_cuts)):
+                tmap[i, :] = min(i // tiles_per_proc, comm.size - 1)
+        else:
+            for j in range(len(col_cuts)):
+                tmap[:, j] = min(j // tiles_per_proc, comm.size - 1)
+        self.__tile_map = tmap
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tile_columns(self) -> int:
+        """Number of tile columns (reference ``:674``)."""
+        return len(self.__col_cuts)
+
+    @property
+    def tile_rows(self) -> int:
+        """Number of tile rows (reference ``:734``)."""
+        return len(self.__row_cuts)
+
+    @property
+    def tile_map(self) -> np.ndarray:
+        """(tile_rows, tile_columns) grid of owning ranks (reference ``:772``)."""
+        return self.__tile_map
+
+    @property
+    def row_indices(self) -> List[int]:
+        """Global start row of each tile row (reference ``:716``)."""
+        return self.__row_inds
+
+    @property
+    def col_indices(self) -> List[int]:
+        """Global start column of each tile column (reference ``:656``)."""
+        return self.__col_inds
+
+    @property
+    def tile_rows_per_process(self) -> List[int]:
+        return self.__tile_rows_per_process
+
+    def get_tile_size(self, key: Tuple[int, int]) -> Tuple[int, int]:
+        i, j = key
+        return self.__row_cuts[i], self.__col_cuts[j]
+
+    def _slices(self, key) -> Tuple[slice, slice]:
+        i, j = key
+        r0 = self.__row_inds[i]
+        c0 = self.__col_inds[j]
+        return slice(r0, r0 + self.__row_cuts[i]), slice(c0, c0 + self.__col_cuts[j])
+
+    def __getitem__(self, key):
+        """The (i, j) tile of the global value (reference ``local_get`` ``:934``)."""
+        return self.__arr.larray[self._slices(key)]
+
+    def __setitem__(self, key, value) -> None:
+        """Set the (i, j) tile (reference ``local_set`` ``:954``)."""
+        sl = self._slices(key)
+        new = self.__arr.larray.at[sl].set(jnp.asarray(value, self.__arr.larray.dtype))
+        self.__arr.larray = self.__arr.comm.shard(new, self.__arr.split)
+
+    # local_get/local_set alias the global accessors: every shard sees the global value
+    local_get = __getitem__
+    local_set = __setitem__
+
+    def match_tiles(self, other: "SquareDiagTiles") -> None:
+        """Align tilings for Q/R pairs (reference ``:1079``). Canonical chunkings always
+        agree here, so this only validates compatibility."""
+        if self.__arr.comm.size != other.arr.comm.size:
+            raise ValueError("tilings live on different communicators")

@@ -1,0 +1,3 @@
+"""Utility subpackage (reference heat/utils/)."""
+
+from . import data

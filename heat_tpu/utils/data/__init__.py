@@ -1,0 +1,3 @@
+"""Data utilities (reference heat/utils/data/)."""
+
+from . import matrixgallery, spherical

@@ -1,0 +1,63 @@
+"""Deterministic test matrices (reference heat/utils/data/matrixgallery.py).
+
+Fixtures for the SVD/QR test-suites: matrices with known spectra built from random
+orthonormal factors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+import heat_tpu as ht
+
+__all__ = ["hermitian", "parter", "random_known_singularvalues", "random_known_rank"]
+
+
+def hermitian(n: int, dtype=None, split: Optional[int] = None, positive_definite: bool = False):
+    """Random (complex) Hermitian n×n matrix (reference ``matrixgallery.py:19``)."""
+    dtype = ht.core.types.canonical_heat_type(dtype or ht.complex64)
+    if ht.core.types.heat_type_is_complexfloating(dtype):
+        real = ht.random.randn(n, n, split=split, dtype=ht.float64)
+        imag = ht.random.randn(n, n, split=split, dtype=ht.float64)
+        x = (real + 1j * imag).astype(dtype)
+    else:
+        x = ht.random.randn(n, n, split=split, dtype=dtype)
+    if positive_definite:
+        return ht.matmul(x, ht.conj(x).T.resplit(x.split)) + float(n) * ht.eye(n, split=split, dtype=dtype)
+    return 0.5 * (x + ht.conj(x).T.resplit(x.split))
+
+
+def parter(n: int, split: Optional[int] = None, device=None, comm=None):
+    """The Parter matrix 1/(i - j + 0.5) (reference ``matrixgallery.py:98``)."""
+    i = ht.arange(n, dtype=ht.float32, split=split, device=device, comm=comm).expand_dims(1)
+    j = ht.arange(n, dtype=ht.float32, device=device, comm=comm).expand_dims(0)
+    return 1.0 / (i - j + 0.5)
+
+
+def random_known_singularvalues(
+    m: int, n: int, singular_values, split: Optional[int] = None, device=None, comm=None
+) -> Tuple:
+    """Random matrix with prescribed singular values; returns (A, (U, s, V))
+    (reference ``matrixgallery.py:144``)."""
+    if not isinstance(singular_values, ht.DNDarray):
+        singular_values = ht.array(np.asarray(singular_values))
+    k = singular_values.gshape[0]
+    if k > min(m, n):
+        raise ValueError(f"too many singular values ({k}) for shape ({m}, {n})")
+    u_full = ht.random.randn(m, k, dtype=singular_values.dtype, split=split)
+    q_u, _ = ht.linalg.qr(u_full)
+    v_full = ht.random.randn(n, k, dtype=singular_values.dtype, split=split)
+    q_v, _ = ht.linalg.qr(v_full)
+    a = ht.matmul(ht.matmul(q_u, ht.diag(singular_values).resplit(None)), q_v.T.resplit(None))
+    return a, (q_u, singular_values, q_v)
+
+
+def random_known_rank(
+    m: int, n: int, r: int, split: Optional[int] = None, device=None, comm=None
+) -> Tuple:
+    """Random matrix of known rank r with decaying spectrum (reference
+    ``matrixgallery.py:180``)."""
+    singular_values = ht.array((np.arange(r, 0, -1) / r).astype(np.float32))
+    return random_known_singularvalues(m, n, singular_values, split=split, device=device, comm=comm)

@@ -1,0 +1,28 @@
+"""Spherical cluster fixtures (reference heat/utils/data/spherical.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import heat_tpu as ht
+
+__all__ = ["create_spherical_dataset"]
+
+
+def create_spherical_dataset(
+    num_samples_cluster: int,
+    radius: float = 1.0,
+    offset: float = 4.0,
+    dtype=None,
+    random_state: int = 1,
+) -> "ht.DNDarray":
+    """Four gaussian balls in 3-D at ±offset on the diagonal (reference
+    ``spherical.py:7``): the standard k-means benchmark/test fixture."""
+    dtype = ht.core.types.canonical_heat_type(dtype or ht.float32)
+    ht.random.seed(random_state)
+    clusters = []
+    for c in (-2.0, -1.0, 1.0, 2.0):
+        center = c * offset
+        pts = ht.random.randn(num_samples_cluster, 3, dtype=dtype, split=0) * radius + center
+        clusters.append(pts)
+    return ht.concatenate(clusters, axis=0).resplit(0)

@@ -1,0 +1,208 @@
+"""Linear algebra tests (reference heat/core/linalg/tests/: test_basics.py 2157 LoC,
+test_qr.py, test_svdtools.py, test_solver.py)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+from heat_tpu.utils.data.matrixgallery import random_known_rank, random_known_singularvalues
+
+
+class TestMatmul(TestCase):
+    def test_matmul_split_cases(self):
+        rng = np.random.default_rng(0)
+        a, b = rng.random((17, 13)), rng.random((13, 11))
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                x, y = ht.array(a, split=sa), ht.array(b, split=sb)
+                self.assert_array_equal(ht.matmul(x, y), a @ b, rtol=1e-5)
+
+    def test_matmul_split_bookkeeping(self):
+        a = ht.array(np.random.default_rng(1).random((8, 6)), split=0)
+        b = ht.array(np.random.default_rng(2).random((6, 4)), split=1)
+        c = ht.matmul(a, b)
+        self.assertEqual(c.split, 0)  # row-split a dominates
+
+    def test_dot_vdot_outer(self):
+        rng = np.random.default_rng(3)
+        u, v = rng.random(9), rng.random(9)
+        for split in (None, 0):
+            x, y = ht.array(u, split=split), ht.array(v, split=split)
+            self.assertAlmostEqual(float(ht.dot(x, y).item()), float(u @ v), places=5)
+            self.assertAlmostEqual(float(ht.vdot(x, y).item()), float(np.vdot(u, v)), places=5)
+            self.assert_array_equal(ht.outer(x, y), np.outer(u, v))
+
+    def test_norms(self):
+        rng = np.random.default_rng(4)
+        a = rng.random((6, 8))
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.norm(x), np.asarray(np.linalg.norm(a)))
+            self.assert_array_equal(ht.vector_norm(x, axis=0), np.linalg.norm(a, axis=0))
+            self.assert_array_equal(ht.matrix_norm(x), np.asarray(np.linalg.norm(a, "fro")))
+
+    def test_inv_det_trace(self):
+        rng = np.random.default_rng(5)
+        a = rng.random((7, 7)) + 7 * np.eye(7)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.inv(x), np.linalg.inv(a), rtol=1e-4)
+            self.assertAlmostEqual(float(ht.det(x).item()), float(np.linalg.det(a)), delta=abs(np.linalg.det(a)) * 1e-4)
+            self.assertAlmostEqual(float(ht.trace(x)), float(np.trace(a)), places=4)
+
+    def test_tri_transpose(self):
+        a = np.arange(20.0).reshape(4, 5)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            self.assert_array_equal(ht.tril(x), np.tril(a))
+            self.assert_array_equal(ht.triu(x, k=1), np.triu(a, k=1))
+            t = ht.transpose(x)
+            self.assert_array_equal(t, a.T)
+            if split is not None:
+                self.assertEqual(t.split, 1 - split)
+
+
+class TestQR(TestCase):
+    def _check_qr(self, a_np, split):
+        a = ht.array(a_np, split=split)
+        q, r = ht.linalg.qr(a)
+        m, n = a_np.shape
+        k = min(m, n)
+        self.assertEqual(tuple(q.shape), (m, k))
+        self.assertEqual(tuple(r.shape), (k, n))
+        np.testing.assert_allclose((q @ r).numpy(), a_np, atol=1e-5)
+        np.testing.assert_allclose(
+            (q.T.resplit(None) @ q).numpy(), np.eye(k), atol=1e-5
+        )
+        # R upper triangular
+        rn = r.numpy()
+        np.testing.assert_allclose(rn, np.triu(rn), atol=1e-6)
+
+    def test_qr_tall_skinny_split0(self):
+        rng = np.random.default_rng(6)
+        self._check_qr(rng.random((64, 8)).astype(np.float64), 0)
+        self._check_qr(rng.random((50, 5)).astype(np.float32), 0)  # ragged rows
+
+    def test_qr_split1_and_none(self):
+        rng = np.random.default_rng(7)
+        self._check_qr(rng.random((20, 12)), 1)
+        self._check_qr(rng.random((20, 12)), None)
+        self._check_qr(rng.random((10, 16)), 1)  # short-fat
+
+    def test_qr_calc_q_false(self):
+        a = ht.array(np.random.default_rng(8).random((32, 4)), split=0)
+        q, r = ht.linalg.qr(a, calc_q=False)
+        self.assertIsNone(q)
+        # R still reproduces the gram structure
+        an = a.numpy()
+        np.testing.assert_allclose(r.numpy().T @ r.numpy(), an.T @ an, atol=1e-4)
+
+    def test_qr_errors(self):
+        with self.assertRaises(ValueError):
+            ht.linalg.qr(ht.ones((3, 3, 3)))
+        with self.assertRaises(TypeError):
+            ht.linalg.qr(np.zeros((3, 3)))
+
+
+class TestHSVD(TestCase):
+    def test_hsvd_rank_exact_recovery(self):
+        for split in (None, 0, 1):
+            A, _ = random_known_rank(40, 24, 4, split=split)
+            An = A.numpy()
+            U, sig, V, err = ht.linalg.hsvd_rank(A, 4, compute_sv=True)
+            recon = U.numpy() @ np.diag(sig.numpy()) @ V.numpy().T
+            np.testing.assert_allclose(recon, An, atol=1e-4)
+            self.assertLess(float(err.item()), 1e-4)
+            np.testing.assert_allclose(np.sort(sig.numpy()), np.sort(np.arange(4, 0, -1) / 4), atol=1e-4)
+
+    def test_hsvd_rank_u_only(self):
+        A, _ = random_known_rank(30, 20, 3, split=1)
+        U, err = ht.linalg.hsvd_rank(A, 3)
+        self.assertEqual(tuple(U.shape), (30, 3))
+        # U spans the true column space: projector reproduces A
+        An = A.numpy()
+        Un = U.numpy()
+        np.testing.assert_allclose(Un @ (Un.T @ An), An, atol=1e-4)
+
+    def test_hsvd_rtol(self):
+        sv = np.array([1.0, 0.5, 0.25, 1e-3, 1e-4], dtype=np.float32)
+        A, _ = random_known_singularvalues(40, 24, sv, split=1)
+        U, sig, V, err = ht.linalg.hsvd_rtol(A, 1e-2, compute_sv=True)
+        An = A.numpy()
+        recon = U.numpy() @ np.diag(sig.numpy()) @ V.numpy().T
+        rel = np.linalg.norm(An - recon) / np.linalg.norm(An)
+        self.assertLess(rel, 1e-2)
+
+    def test_svd_stub(self):
+        with self.assertRaises(NotImplementedError):
+            ht.linalg.svd(ht.ones((4, 4)))
+
+    def test_hsvd_errors(self):
+        with self.assertRaises(RuntimeError):
+            ht.linalg.hsvd_rank(ht.ones(5), 2)
+        with self.assertRaises(ValueError):
+            ht.linalg.hsvd(ht.ones((4, 4)))
+
+
+class TestSolver(TestCase):
+    def test_cg(self):
+        rng = np.random.default_rng(9)
+        a = rng.random((15, 15))
+        spd = a @ a.T + 15 * np.eye(15)
+        b = rng.random(15)
+        expected = np.linalg.solve(spd, b)
+        for split in (None, 0):
+            A = ht.array(spd, split=split)
+            x = ht.linalg.cg(A, ht.array(b), ht.zeros(15, dtype=ht.float64))
+            np.testing.assert_allclose(x.numpy(), expected, atol=1e-6)
+        out = ht.zeros(15, dtype=ht.float64)
+        ht.linalg.cg(ht.array(spd), ht.array(b), ht.zeros(15, dtype=ht.float64), out=out)
+        np.testing.assert_allclose(out.numpy(), expected, atol=1e-6)
+
+    def test_cg_errors(self):
+        with self.assertRaises(TypeError):
+            ht.linalg.cg(np.eye(3), ht.ones(3), ht.ones(3))
+        with self.assertRaises(RuntimeError):
+            ht.linalg.cg(ht.ones(3), ht.ones(3), ht.ones(3))
+
+    def test_lanczos(self):
+        rng = np.random.default_rng(10)
+        a = rng.random((16, 16))
+        spd = (a @ a.T + 16 * np.eye(16)).astype(np.float64)
+        for split in (None, 0):
+            A = ht.array(spd, split=split)
+            V, T = ht.linalg.lanczos(A, 16)
+            # V orthonormal, T tridiagonal similar to A
+            np.testing.assert_allclose(V.numpy().T @ V.numpy(), np.eye(16), atol=1e-6)
+            ev_T = np.sort(np.linalg.eigvalsh(T.numpy()))
+            ev_A = np.sort(np.linalg.eigvalsh(spd))
+            np.testing.assert_allclose(ev_T, ev_A, rtol=1e-5)
+
+
+class TestTiling(TestCase):
+    def test_split_tiles(self):
+        a = ht.array(np.arange(48.0).reshape(6, 8), split=0)
+        tiles = ht.tiling.SplitTiles(a)
+        dims = tiles.tile_dimensions
+        self.assertEqual(dims.shape, (2, self.comm.size))
+        self.assertEqual(int(dims[0].sum()), 6)
+        self.assertEqual(int(dims[1].sum()), 8)
+        # first tile = first chunk rows
+        t0 = np.asarray(tiles[0])
+        np.testing.assert_array_equal(t0, a.numpy()[: t0.shape[0]])
+
+    def test_square_diag_tiles(self):
+        a = ht.array(np.arange(64.0).reshape(8, 8), split=0)
+        tiles = ht.tiling.SquareDiagTiles(a, tiles_per_proc=1)
+        self.assertEqual(tiles.tile_map.shape, (tiles.tile_rows, tiles.tile_columns))
+        # tiles reassemble the matrix
+        rows = []
+        for i in range(tiles.tile_rows):
+            rows.append(np.concatenate([np.asarray(tiles[i, j]) for j in range(tiles.tile_columns)], axis=1))
+        np.testing.assert_array_equal(np.concatenate(rows, axis=0), a.numpy())
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
