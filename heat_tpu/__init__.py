@@ -24,4 +24,12 @@ _jax.config.update("jax_default_matmul_precision", "highest")
 from .core import *
 from .core import __version__
 from . import core
+from . import fft
 from . import utils
+from . import spatial
+from . import cluster
+from . import classification
+from . import naive_bayes
+from . import regression
+from . import preprocessing
+from . import graph

@@ -1,0 +1,4 @@
+"""Classification algorithms (reference heat/classification/)."""
+
+from .kneighborsclassifier import *
+from . import kneighborsclassifier

@@ -1,0 +1,59 @@
+"""K-nearest-neighbour classifier (reference heat/classification/kneighborsclassifier.py,
+133 LoC): cdist to the split training set, top-k, one-hot vote."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["KNeighborsClassifier"]
+
+
+class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
+    """KNN voting classifier (reference ``kneighborsclassifier.py:10``)."""
+
+    def __init__(self, n_neighbors: int = 5, effective_metric_: Optional[Callable] = None):
+        self.n_neighbors = n_neighbors
+        self.effective_metric_ = effective_metric_ or ht.spatial.cdist
+        self.x = None
+        self.y = None
+
+    @staticmethod
+    def one_hot_encoding(x: DNDarray) -> DNDarray:
+        """One-hot encode integer labels (reference ``kneighborsclassifier.py:46``)."""
+        xv = x.larray.reshape(-1).astype(jnp.int64)
+        n_classes = int(jnp.max(xv)) + 1 if x.size else 0
+        enc = (xv[:, None] == jnp.arange(n_classes)[None, :]).astype(jnp.float32)
+        from ..core._operations import wrap_result
+
+        return wrap_result(enc, x, 0 if x.split is not None else None)
+
+    def fit(self, x: DNDarray, y: DNDarray) -> "KNeighborsClassifier":
+        """Store the training set; one-hot encode 1-D labels
+        (reference ``kneighborsclassifier.py:63``)."""
+        self.x = x
+        if y.ndim == 1 or (y.ndim == 2 and y.gshape[1] == 1):
+            self.y = self.one_hot_encoding(y)
+        else:
+            self.y = y
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Majority vote among the k nearest training samples
+        (reference ``kneighborsclassifier.py:114``)."""
+        if self.x is None:
+            raise RuntimeError("fit needs to be called before predict")
+        distances = self.effective_metric_(x, self.x)
+        _, indices = ht.topk(distances, self.n_neighbors, largest=False)
+        onehot = self.y.larray  # (n_train, n_classes), replicated or sharded
+        votes = jnp.take(onehot, indices.larray, axis=0)  # (n_test, k, n_classes)
+        counts = jnp.sum(votes, axis=1)
+        labels = jnp.argmax(counts, axis=1).astype(jnp.int64)
+        from ..core._operations import wrap_result
+
+        return wrap_result(labels, x, 0 if x.split is not None else None)

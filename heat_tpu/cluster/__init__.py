@@ -1,0 +1,8 @@
+"""Clustering algorithms (reference heat/cluster/)."""
+
+from .batchparallelclustering import *
+from .kmeans import *
+from .kmedians import *
+from .kmedoids import *
+from .spectral import *
+from . import batchparallelclustering, kmeans, kmedians, kmedoids, spectral

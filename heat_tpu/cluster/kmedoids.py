@@ -1,0 +1,72 @@
+"""K-Medoids clustering (reference heat/cluster/kmedoids.py, 129 LoC)."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from ..core.dndarray import DNDarray
+from ._kcluster import _KCluster
+
+__all__ = ["KMedoids"]
+
+
+class KMedoids(_KCluster):
+    """k-medoids: centroids are constrained to be data points — after a mean update the
+    nearest actual sample is snapped in (reference ``kmedoids.py:11``)."""
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        random_state: Optional[int] = None,
+    ):
+        if isinstance(init, str) and init == "kmedoids++":
+            init = "probability_based"
+        super().__init__(
+            metric=lambda x, y: ht.spatial.cdist(x, y),
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=0.0,
+            random_state=random_state,
+        )
+
+    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
+        """Mean per cluster, then snap to the closest sample (reference
+        ``kmedoids.py:69-116``)."""
+        xv = x.larray
+        labels = matching_centroids.larray.reshape(-1)
+        k = self.n_clusters
+        sums = jnp.zeros((k, xv.shape[1]), xv.dtype).at[labels].add(xv)
+        counts = jnp.zeros((k,), xv.dtype).at[labels].add(1.0)
+        means = sums / jnp.maximum(counts[:, None], 1.0)
+        old = self._cluster_centers.larray
+        means = jnp.where(counts[:, None] > 0, means, old)
+        # snap each mean to the nearest point of its own cluster
+        d = jnp.sum((xv[:, None, :] - means[None, :, :]) ** 2, axis=-1)  # (n, k)
+        d = jnp.where(labels[:, None] == jnp.arange(k)[None, :], d, jnp.inf)
+        nearest = jnp.argmin(d, axis=0)  # (k,)
+        snapped = xv[nearest]
+        snapped = jnp.where(counts[:, None] > 0, snapped, old)
+        return ht.array(snapped, comm=x.comm)
+
+    def fit(self, x: DNDarray) -> "KMedoids":
+        """Cluster ``x`` (reference ``kmedoids.py:118``)."""
+        if not isinstance(x, DNDarray):
+            raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
+        self._initialize_cluster_centers(x)
+        self._n_iter = 0
+        for epoch in range(self.max_iter):
+            matching_centroids = self._assign_to_cluster(x)
+            new_centers = self._update_centroids(x, matching_centroids)
+            self._n_iter += 1
+            shift = float(ht.sum((self._cluster_centers - new_centers) ** 2).item())
+            self._cluster_centers = new_centers
+            if shift == 0.0:
+                break
+        self._labels = self._assign_to_cluster(x, eval_functional_value=True)
+        return self

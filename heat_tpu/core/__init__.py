@@ -21,6 +21,7 @@ from .manipulations import *
 from .indexing import *
 from .signal import *
 from .tiling import *
+from .base import *
 from . import random
 from . import linalg
 from .linalg import *  # promoted to the flat namespace like the reference
@@ -28,6 +29,7 @@ from .version import __version__
 
 from . import (
     arithmetics,
+    base,
     communication,
     complex_math,
     devices,

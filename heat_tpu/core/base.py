@@ -1,0 +1,153 @@
+"""Estimator base classes (reference heat/core/base.py, 318 LoC): the sklearn-style
+get_params/set_params protocol plus the fit/predict/transform mixins every domain module
+builds on."""
+
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List
+
+from .dndarray import DNDarray
+
+__all__ = [
+    "BaseEstimator",
+    "ClassificationMixin",
+    "ClusteringMixin",
+    "RegressionMixin",
+    "TransformMixin",
+    "is_classifier",
+    "is_clusterer",
+    "is_estimator",
+    "is_regressor",
+    "is_transformer",
+]
+
+
+class BaseEstimator:
+    """Base for all estimators (reference ``base.py:13``)."""
+
+    @classmethod
+    def _parameter_names(cls) -> List[str]:
+        """Constructor parameter names, the sklearn introspection contract
+        (reference ``base.py:19``)."""
+        init = cls.__init__
+        if init is object.__init__:
+            return []
+        sig = inspect.signature(init)
+        return sorted(
+            p.name
+            for p in sig.parameters.values()
+            if p.name != "self" and p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+        )
+
+    def get_params(self, deep: bool = True) -> Dict[str, object]:
+        """Parameters of this estimator (reference ``base.py:37``)."""
+        params = {}
+        for key in self._parameter_names():
+            value = getattr(self, key)
+            if deep and hasattr(value, "get_params"):
+                for sub_key, sub_value in value.get_params().items():
+                    params[f"{key}__{sub_key}"] = sub_value
+            params[key] = value
+        return params
+
+    def set_params(self, **params) -> "BaseEstimator":
+        """Set parameters; supports nested ``component__parameter`` keys
+        (reference ``base.py:68``)."""
+        if not params:
+            return self
+        valid = self.get_params(deep=True)
+        nested = {}
+        for key, value in params.items():
+            key, delim, sub_key = key.partition("__")
+            if key not in valid:
+                raise ValueError(f"invalid parameter {key} for estimator {self}")
+            if delim:
+                nested.setdefault(key, {})[sub_key] = value
+            else:
+                setattr(self, key, value)
+        for key, sub_params in nested.items():
+            getattr(self, key).set_params(**sub_params)
+        return self
+
+    def __repr__(self, indent: int = 1) -> str:
+        params = ", ".join(f"{k}={v!r}" for k, v in sorted(self.get_params(deep=False).items()))
+        return f"{self.__class__.__name__}({params})"
+
+
+class ClassificationMixin:
+    """Mixin for classifiers (reference ``base.py:96``)."""
+
+    def fit(self, x: DNDarray, y: DNDarray):
+        raise NotImplementedError()
+
+    def fit_predict(self, x: DNDarray, y: DNDarray) -> DNDarray:
+        self.fit(x, y)
+        return self.predict(x)
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        raise NotImplementedError()
+
+
+class TransformMixin:
+    """Mixin for transformers (reference ``base.py:143``)."""
+
+    def fit(self, x: DNDarray):
+        raise NotImplementedError()
+
+    def fit_transform(self, x: DNDarray) -> DNDarray:
+        self.fit(x)
+        return self.transform(x)
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        raise NotImplementedError()
+
+
+class ClusteringMixin:
+    """Mixin for clusterers (reference ``base.py:184``)."""
+
+    def fit(self, x: DNDarray):
+        raise NotImplementedError()
+
+    def fit_predict(self, x: DNDarray) -> DNDarray:
+        self.fit(x)
+        return self.labels_
+
+
+class RegressionMixin:
+    """Mixin for regressors (reference ``base.py:215``)."""
+
+    def fit(self, x: DNDarray, y: DNDarray):
+        raise NotImplementedError()
+
+    def fit_predict(self, x: DNDarray, y: DNDarray) -> DNDarray:
+        self.fit(x, y)
+        return self.predict(x)
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        raise NotImplementedError()
+
+
+def is_classifier(estimator: object) -> bool:
+    """True for classifiers (reference ``base.py:260``)."""
+    return isinstance(estimator, ClassificationMixin)
+
+
+def is_transformer(estimator: object) -> bool:
+    """True for transformers (reference ``base.py:272``)."""
+    return isinstance(estimator, TransformMixin)
+
+
+def is_estimator(estimator: object) -> bool:
+    """True for estimators (reference ``base.py:284``)."""
+    return isinstance(estimator, BaseEstimator)
+
+
+def is_clusterer(estimator: object) -> bool:
+    """True for clusterers (reference ``base.py:296``)."""
+    return isinstance(estimator, ClusteringMixin)
+
+
+def is_regressor(estimator: object) -> bool:
+    """True for regressors (reference ``base.py:309``)."""
+    return isinstance(estimator, RegressionMixin)

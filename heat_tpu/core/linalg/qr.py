@@ -52,11 +52,13 @@ def qr(
     nproc = a.comm.size
 
     if a.split == 0 and a.is_distributed() and m >= n * nproc:
-        q_val, r_val = _tsqr(a.larray, nproc)
-    else:
+        q_val, r_val = _tsqr(a.larray, nproc, calc_q=calc_q)
+    elif calc_q:
         # split=1 / None / short-fat: XLA's QR on the global value (the reference's
         # split=1 path is a panel loop with Bcast, qr.py:866 — subsumed by SPMD)
         q_val, r_val = jnp.linalg.qr(a.larray, mode="reduced")
+    else:
+        q_val, r_val = None, jnp.linalg.qr(a.larray, mode="r")
 
     r_split = a.split if a.split is not None and a.split < 2 else None
     if a.split == 0:
@@ -77,12 +79,14 @@ def qr(
     return QR_t(q, r)
 
 
-def _tsqr(x: jax.Array, nblocks: int) -> Tuple[jax.Array, jax.Array]:
+def _tsqr(x: jax.Array, nblocks: int, calc_q: bool = True) -> Tuple[Optional[jax.Array], jax.Array]:
     """Two-level TSQR of a tall-skinny (m, n) array split into ``nblocks`` row panels.
 
     Level 1: batched QR of the panels (runs shard-local under SPMD).
     Level 2: QR of the (nblocks*n, n) R-stack — small, replicated.
     Combine: Q = blockdiag(Q_i) @ Q2, computed as a batched matmul.
+    With ``calc_q=False`` only the R factors are formed (mode='r'), skipping the
+    dominant Q-assembly cost.
     """
     m, n = x.shape
     rows = -(-m // nblocks)  # canonical ceil-division chunk, matching the sharding
@@ -90,6 +94,10 @@ def _tsqr(x: jax.Array, nblocks: int) -> Tuple[jax.Array, jax.Array]:
     if pad:
         x = jnp.concatenate([x, jnp.zeros((pad, n), x.dtype)], axis=0)
     panels = x.reshape(nblocks, rows, n)
+    if not calc_q:
+        r1 = jnp.linalg.qr(panels, mode="r")
+        r = jnp.linalg.qr(r1.reshape(nblocks * r1.shape[1], n), mode="r")
+        return None, r
     q1, r1 = jnp.linalg.qr(panels, mode="reduced")  # (B, rows, k), (B, k, n)
     k = r1.shape[1]
     q2, r = jnp.linalg.qr(r1.reshape(nblocks * k, n), mode="reduced")

@@ -43,14 +43,14 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
         rsnew = dot(r, r)
         if float(rsnew.item() if isinstance(rsnew, DNDarray) else rsnew) ** 0.5 < 1e-10:
             if out is not None:
-                out.larray = x.larray
+                out.larray = out.comm.shard(x.larray.astype(out.larray.dtype), out.split)
                 return out
             return x
         p = r + (rsnew / rsold) * p
         rsold = rsnew
 
     if out is not None:
-        out.larray = x.larray
+        out.larray = out.comm.shard(x.larray.astype(out.larray.dtype), out.split)
         return out
     return x
 
@@ -122,9 +122,9 @@ def lanczos(
 
     V_dnd = transpose(stack(V, axis=0), None)
     if V_out is not None:
-        V_out.larray = V_dnd.larray
+        V_out.larray = V_out.comm.shard(V_dnd.larray.astype(V_out.larray.dtype), V_out.split)
         V_dnd = V_out
     if T_out is not None:
-        T_out.larray = T.larray
+        T_out.larray = T_out.comm.shard(T.larray.astype(T_out.larray.dtype), T_out.split)
         return V_dnd, T_out
     return V_dnd, T

@@ -131,7 +131,7 @@ def hsvd(
     sigmas: List[jax.Array] = [None] * len(nodes)
     new_nodes, new_err, new_sig = [], [], []
     for i, blk in enumerate(nodes):
-        u, s, e = _local_truncated_svd(level, i, blk, maxrank, loc_atol, safetyshift)
+        u, s, e = _local_truncated_svd(level, i, blk, maxrank, loc_atol, safetyshift, silent)
         new_nodes.append(u * s)  # carry U·diag(sigma) into the merges, like the Sends
         new_err.append(e)
         new_sig.append(s)
@@ -164,7 +164,7 @@ def hsvd(
                 merged_sig.append(sigmas[i])
             else:
                 cat = jnp.concatenate(group, axis=1)
-                u, s, e = _local_truncated_svd(level, i, cat, maxrank, loc_atol, safetyshift)
+                u, s, e = _local_truncated_svd(level, i, cat, maxrank, loc_atol, safetyshift, silent)
                 merged_nodes.append(u * s)
                 merged_err.append(group_err + e)
                 merged_sig.append(s)
@@ -173,7 +173,7 @@ def hsvd(
 
     # final truncation removes the safetyshift (reference svdtools.py:419-421)
     final_u, final_sigma, final_err = _local_truncated_svd(
-        level + 1, 0, nodes[0], maxrank, loc_atol, 0
+        level + 1, 0, nodes[0], maxrank, loc_atol, 0, silent
     )
     total_err_squared = sum(err_squared) + final_err
     rel_err = float(np.sqrt(total_err_squared)) / Anorm if Anorm > 0 else 0.0
@@ -207,6 +207,7 @@ def _local_truncated_svd(
     maxrank: int,
     loc_atol: Optional[float],
     safetyshift: int,
+    silent: bool = True,
 ) -> Tuple[jax.Array, jax.Array, float]:
     """Truncated SVD of one tree node (reference ``compute_local_truncated_svd``
     ``svdtools.py:478``): noise-floor cut, rank/atol truncation, safety shift, and the
@@ -235,7 +236,7 @@ def _local_truncated_svd(
         tails = np.array([np.linalg.norm(s_np[k:]) ** 2 for k in range(len(s_np) + 1)])
         ideal = int(np.nonzero(tails < loc_atol**2)[0].min())
         trunc = min(maxrank, ideal, cut_noise_rank)
-        if trunc != ideal:
+        if trunc != ideal and not silent:
             print(
                 f"in hSVD (level {level}, node {node_id}): atol requires rank {ideal}, "
                 f"but maxrank={maxrank}. Loss of desired precision likely!"

@@ -97,32 +97,41 @@ class SquareDiagTiles:
         self.__arr = arr
         comm = arr.comm
         m, n = arr.gshape
-        split = arr.split if arr.split is not None else 0
 
-        # row cuts: each shard's chunk split into tiles_per_proc near-equal pieces
-        row_cuts: List[int] = []
-        for r in range(comm.size if arr.split is not None else 1):
-            start, lshape, _ = comm.chunk(arr.gshape, split, rank=r)
-            extent = lshape[split]
-            base = extent // tiles_per_proc
-            rem = extent % tiles_per_proc
-            for t in range(tiles_per_proc):
-                row_cuts.append(base + (1 if t < rem else 0))
-        row_cuts = [c for c in row_cuts if c > 0]
-        if not row_cuts:
-            row_cuts = [m]
-        # column cuts mirror row cuts up to n (square diagonal tiles), remainder appended
-        col_cuts: List[int] = []
-        acc = 0
-        for c in row_cuts:
-            if acc + c <= n:
-                col_cuts.append(c)
-                acc += c
-            elif n - acc > 0:
-                col_cuts.append(n - acc)
-                acc = n
-        if acc < n:
-            col_cuts.append(n - acc)
+        def _primary_cuts(extent_axis: int) -> List[int]:
+            # each shard's chunk along the split axis, divided into tiles_per_proc pieces
+            cuts: List[int] = []
+            for r in range(comm.size if arr.split is not None else 1):
+                _, lshape, _ = comm.chunk(arr.gshape, arr.split if arr.split is not None else extent_axis, rank=r)
+                extent = lshape[extent_axis]
+                base = extent // tiles_per_proc
+                rem = extent % tiles_per_proc
+                for t in range(tiles_per_proc):
+                    cuts.append(base + (1 if t < rem else 0))
+            cuts = [c for c in cuts if c > 0]
+            return cuts or [arr.gshape[extent_axis]]
+
+        def _mirror_cuts(primary: List[int], total: int) -> List[int]:
+            # mirror the primary cuts up to `total` (square diagonal tiles), remainder
+            # appended so the grid always covers the full matrix
+            cuts: List[int] = []
+            acc = 0
+            for c in primary:
+                if acc >= total:
+                    break
+                take = min(c, total - acc)
+                cuts.append(take)
+                acc += take
+            if acc < total:
+                cuts.append(total - acc)
+            return cuts
+
+        if arr.split == 1:
+            col_cuts = _primary_cuts(1)
+            row_cuts = _mirror_cuts(col_cuts, m)
+        else:
+            row_cuts = _primary_cuts(0)
+            col_cuts = _mirror_cuts(row_cuts, n)
 
         self.__row_per_proc_list = [tiles_per_proc] * comm.size
         self.__tile_rows_per_process = [tiles_per_proc] * comm.size

@@ -1,0 +1,4 @@
+"""Distributed FFTs (reference heat/fft/)."""
+
+from .fft import *
+from . import fft

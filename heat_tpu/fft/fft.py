@@ -1,0 +1,217 @@
+"""numpy.fft-compatible distributed FFTs (reference heat/fft/fft.py, 1120 LoC).
+
+The reference's strategy (``__fft_op`` ``fft.py:40-137``): a transform along a non-split
+axis is purely local torch.fft; a transform along the split axis is a *pencil
+decomposition* — transpose the axis to 0, ``resplit(1)``, transform locally,
+``resplit_(0)``, transpose back. On TPU the pencil dance is exactly what XLA SPMD emits
+for an FFT over a sharded dimension (all-to-all re-layout, local FFT, all-to-all back),
+so every wrapper here is one ``jnp.fft`` call plus split bookkeeping: real/complex
+transforms that change the last-axis length keep the split unless it sits on the
+transformed axis, in which case the output stays sharded the same way the input was.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import types
+from ..core._operations import wrap_result
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+from ..core.stride_tricks import sanitize_axis
+
+__all__ = [
+    "fft",
+    "fft2",
+    "fftfreq",
+    "fftn",
+    "fftshift",
+    "hfft",
+    "hfft2",
+    "hfftn",
+    "ifft",
+    "ifft2",
+    "ifftn",
+    "ifftshift",
+    "ihfft",
+    "ihfft2",
+    "ihfftn",
+    "irfft",
+    "irfft2",
+    "irfftn",
+    "rfft",
+    "rfft2",
+    "rfftfreq",
+    "rfftn",
+]
+
+
+def _fft_op(x: DNDarray, op, n=None, axis=-1, norm=None) -> DNDarray:
+    """Single-axis transform (reference ``__fft_op`` ``fft.py:40``)."""
+    sanitize_in(x)
+    axis = sanitize_axis(x.gshape, axis)
+    result = op(x.larray, n=n, axis=axis, norm=norm)
+    return wrap_result(result, x, x.split)
+
+
+def _fftn_op(x: DNDarray, op, s=None, axes=None, norm=None) -> DNDarray:
+    """n-D transform (reference ``__fftn_op`` ``fft.py:139``)."""
+    sanitize_in(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.gshape, ax) for ax in axes)
+    result = op(x.larray, s=s, axes=axes, norm=norm)
+    return wrap_result(result, x, x.split)
+
+
+def fft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """1-D discrete Fourier transform (reference ``fft.py:256``)."""
+    return _fft_op(x, jnp.fft.fft, n, axis, norm)
+
+
+def ifft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """Inverse 1-D DFT (reference ``fft.py:465``)."""
+    return _fft_op(x, jnp.fft.ifft, n, axis, norm)
+
+
+def fft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
+    """2-D DFT (reference ``fft.py:293``)."""
+    return _fftn_op(x, jnp.fft.fft2, s, axes, norm)
+
+
+def ifft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
+    """Inverse 2-D DFT (reference ``fft.py:502``)."""
+    return _fftn_op(x, jnp.fft.ifft2, s, axes, norm)
+
+
+def fftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarray:
+    """n-D DFT (reference ``fft.py:334``)."""
+    return _fftn_op(x, jnp.fft.fftn, s, axes, norm)
+
+
+def ifftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarray:
+    """Inverse n-D DFT (reference ``fft.py:543``)."""
+    return _fftn_op(x, jnp.fft.ifftn, s, axes, norm)
+
+
+def rfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """1-D DFT of a real input (reference ``fft.py:837``)."""
+    if types.heat_type_is_complexfloating(x.dtype):
+        raise TypeError("rfft requires a real input; use fft for complex data")
+    return _fft_op(x, jnp.fft.rfft, n, axis, norm)
+
+
+def irfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """Inverse of rfft (reference ``fft.py:647``)."""
+    return _fft_op(x, jnp.fft.irfft, n, axis, norm)
+
+
+def rfft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
+    """2-D real DFT (reference ``fft.py:874``)."""
+    if types.heat_type_is_complexfloating(x.dtype):
+        raise TypeError("rfft2 requires a real input; use fft2 for complex data")
+    return _fftn_op(x, jnp.fft.rfft2, s, axes, norm)
+
+
+def irfft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
+    """Inverse 2-D real DFT (reference ``fft.py:684``)."""
+    return _fftn_op(x, jnp.fft.irfft2, s, axes, norm)
+
+
+def rfftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarray:
+    """n-D real DFT (reference ``fft.py:915``)."""
+    if types.heat_type_is_complexfloating(x.dtype):
+        raise TypeError("rfftn requires a real input; use fftn for complex data")
+    return _fftn_op(x, jnp.fft.rfftn, s, axes, norm)
+
+
+def irfftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarray:
+    """Inverse n-D real DFT (reference ``fft.py:725``)."""
+    return _fftn_op(x, jnp.fft.irfftn, s, axes, norm)
+
+
+def hfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """DFT of a Hermitian-symmetric signal (reference ``fft.py:375``)."""
+    return _fft_op(x, jnp.fft.hfft, n, axis, norm)
+
+
+def ihfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[str] = None) -> DNDarray:
+    """Inverse of hfft (reference ``fft.py:580``)."""
+    return _fft_op(x, jnp.fft.ihfft, n, axis, norm)
+
+
+def hfft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
+    """2-D Hermitian DFT (reference ``fft.py:416``)."""
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarray:
+    """n-D Hermitian DFT (reference ``fft.py:440``; numpy.fft has no hfftn — semantics
+    follow torch.fft.hfftn: ``hfftn(x) = irfftn(conj(x))`` with inverse normalization)."""
+    sanitize_in(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.gshape, ax) for ax in axes)
+    xv = jnp.conj(x.larray)
+    # hfftn(x, norm) == irfftn(conj(x), norm-swapped): "backward" applies no forward
+    # scaling, which is irfftn's "forward" behaviour (numpy hfft = irfft(conj(a), n)*n)
+    inv = {None: "forward", "backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
+    result = jnp.fft.irfftn(xv, s=s, axes=axes, norm=inv)
+    return wrap_result(result, x, x.split)
+
+
+def ihfft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
+    """Inverse 2-D Hermitian DFT (reference ``fft.py:605``)."""
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarray:
+    """Inverse n-D Hermitian DFT (``ihfftn(x) = conj(rfftn(x))`` with inverse norm)."""
+    sanitize_in(x)
+    if types.heat_type_is_complexfloating(x.dtype):
+        raise TypeError("ihfftn requires a real input")
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.gshape, ax) for ax in axes)
+    inv = {None: "forward", "backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
+    result = jnp.conj(jnp.fft.rfftn(x.larray, s=s, axes=axes, norm=inv))
+    return wrap_result(result, x, x.split)
+
+
+def fftfreq(n: int, d: float = 1.0, dtype=None, split: Optional[int] = None, device=None, comm=None) -> DNDarray:
+    """Sample frequencies of a DFT (reference ``fft.py:963``)."""
+    from ..core import factories
+
+    result = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+    return factories.array(result, split=split, device=device, comm=comm)
+
+
+def rfftfreq(n: int, d: float = 1.0, dtype=None, split: Optional[int] = None, device=None, comm=None) -> DNDarray:
+    """Sample frequencies of a real DFT (reference ``fft.py:1032``)."""
+    from ..core import factories
+
+    result = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+    return factories.array(result, split=split, device=device, comm=comm)
+
+
+def fftshift(x: DNDarray, axes=None) -> DNDarray:
+    """Shift the zero-frequency component to the center (reference ``fft.py:1002``)."""
+    sanitize_in(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.gshape, ax) for ax in axes) if isinstance(axes, (tuple, list)) else sanitize_axis(x.gshape, axes)
+    result = jnp.fft.fftshift(x.larray, axes=axes)
+    return wrap_result(result, x, x.split)
+
+
+def ifftshift(x: DNDarray, axes=None) -> DNDarray:
+    """Inverse of fftshift (reference ``fft.py:1070``)."""
+    sanitize_in(x)
+    if axes is not None:
+        axes = tuple(sanitize_axis(x.gshape, ax) for ax in axes) if isinstance(axes, (tuple, list)) else sanitize_axis(x.gshape, axes)
+    result = jnp.fft.ifftshift(x.larray, axes=axes)
+    return wrap_result(result, x, x.split)

@@ -1,0 +1,4 @@
+"""Graph algorithms (reference heat/graph/)."""
+
+from .laplacian import *
+from . import laplacian

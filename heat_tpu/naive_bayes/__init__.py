@@ -1,0 +1,4 @@
+"""Naive Bayes estimators (reference heat/naive_bayes/)."""
+
+from .gaussianNB import *
+from . import gaussianNB

@@ -1,0 +1,4 @@
+"""Preprocessing transformers (reference heat/preprocessing/)."""
+
+from .preprocessing import *
+from . import preprocessing

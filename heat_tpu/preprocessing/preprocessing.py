@@ -1,0 +1,199 @@
+"""Feature scalers (reference heat/preprocessing/preprocessing.py, 601 LoC): the five
+sklearn-style transformers. Every statistic is a global reduction over the sharded
+sample axis — XLA emits the cross-shard psum the reference got from Allreduce."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from ..core.base import BaseEstimator, TransformMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["StandardScaler", "MinMaxScaler", "Normalizer", "MaxAbsScaler", "RobustScaler"]
+
+
+def _check_2d_float(x: DNDarray, name: str) -> None:
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"{name} requires a DNDarray, got {type(x)}")
+    if x.dtype not in (ht.float32, ht.float64):
+        raise TypeError(f"{name} requires float32/float64 data, got {x.dtype}")
+
+
+class StandardScaler(TransformMixin, BaseEstimator):
+    """Standardize to zero mean / unit variance (reference ``preprocessing.py:49``)."""
+
+    def __init__(self, *, copy: bool = True, with_mean: bool = True, with_std: bool = True):
+        self.copy = copy
+        self.with_mean = with_mean
+        self.with_std = with_std
+        self.mean_ = None
+        self.var_ = None
+
+    def fit(self, x: DNDarray, sample_weight=None) -> "StandardScaler":
+        _check_2d_float(x, "StandardScaler")
+        self.mean_ = ht.mean(x, axis=0) if self.with_mean or self.with_std else None
+        if self.with_std:
+            self.var_ = ht.var(x, axis=0)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        _check_2d_float(x, "StandardScaler")
+        out = x
+        if self.with_mean:
+            out = out - self.mean_
+        if self.with_std:
+            scale = ht.sqrt(self.var_)
+            safe = ht.where(scale == 0.0, 1.0, scale)
+            out = out / safe.astype(out.dtype)
+        return out
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        out = y
+        if self.with_std:
+            out = out * ht.sqrt(self.var_).astype(out.dtype)
+        if self.with_mean:
+            out = out + self.mean_
+        return out
+
+
+class MinMaxScaler(TransformMixin, BaseEstimator):
+    """Scale each feature to a range (reference ``preprocessing.py:158``)."""
+
+    def __init__(self, feature_range: Tuple[float, float] = (0.0, 1.0), *, copy: bool = True, clip: bool = False):
+        if feature_range[0] >= feature_range[1]:
+            raise ValueError("feature_range minimum must be smaller than maximum")
+        self.feature_range = feature_range
+        self.copy = copy
+        self.clip = clip
+        self.data_min_ = None
+        self.data_max_ = None
+        self.scale_ = None
+        self.min_ = None
+
+    def fit(self, x: DNDarray) -> "MinMaxScaler":
+        _check_2d_float(x, "MinMaxScaler")
+        self.data_min_ = ht.min(x, axis=0)
+        self.data_max_ = ht.max(x, axis=0)
+        rng = self.data_max_ - self.data_min_
+        safe = ht.where(rng == 0.0, 1.0, rng)
+        lo, hi = self.feature_range
+        self.scale_ = (hi - lo) / safe
+        self.min_ = lo - self.data_min_ * self.scale_
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        _check_2d_float(x, "MinMaxScaler")
+        out = x * self.scale_.astype(x.dtype) + self.min_.astype(x.dtype)
+        if self.clip:
+            out = ht.clip(out, self.feature_range[0], self.feature_range[1])
+        return out
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        return (y - self.min_.astype(y.dtype)) / self.scale_.astype(y.dtype)
+
+
+class Normalizer(TransformMixin, BaseEstimator):
+    """Normalize samples to unit norm (reference ``preprocessing.py:284``)."""
+
+    def __init__(self, norm: str = "l2", *, copy: bool = True):
+        if norm not in ("l1", "l2", "max"):
+            raise NotImplementedError(f"unsupported norm {norm!r}")
+        self.norm = norm
+        self.copy = copy
+
+    def fit(self, x: DNDarray) -> "Normalizer":
+        return self  # stateless, like the reference
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        _check_2d_float(x, "Normalizer")
+        xv = x.larray
+        if self.norm == "l1":
+            n = jnp.sum(jnp.abs(xv), axis=1, keepdims=True)
+        elif self.norm == "l2":
+            n = jnp.sqrt(jnp.sum(xv * xv, axis=1, keepdims=True))
+        else:
+            n = jnp.max(jnp.abs(xv), axis=1, keepdims=True)
+        n = jnp.where(n == 0, 1.0, n)
+        from ..core._operations import wrap_result
+
+        return wrap_result(xv / n, x, x.split)
+
+
+class MaxAbsScaler(TransformMixin, BaseEstimator):
+    """Scale by the maximum absolute value per feature (reference ``preprocessing.py:358``)."""
+
+    def __init__(self, *, copy: bool = True):
+        self.copy = copy
+        self.max_abs_ = None
+        self.scale_ = None
+
+    def fit(self, x: DNDarray) -> "MaxAbsScaler":
+        _check_2d_float(x, "MaxAbsScaler")
+        self.max_abs_ = ht.max(ht.abs(x), axis=0)
+        self.scale_ = ht.where(self.max_abs_ == 0.0, 1.0, self.max_abs_)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        _check_2d_float(x, "MaxAbsScaler")
+        return x / self.scale_.astype(x.dtype)
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        return y * self.scale_.astype(y.dtype)
+
+
+class RobustScaler(TransformMixin, BaseEstimator):
+    """Center/scale by median and IQR (reference ``preprocessing.py:444``)."""
+
+    def __init__(
+        self,
+        *,
+        with_centering: bool = True,
+        with_scaling: bool = True,
+        quantile_range: Tuple[float, float] = (25.0, 75.0),
+        copy: bool = True,
+        unit_variance: bool = False,
+    ):
+        lo, hi = quantile_range
+        if not 0 <= lo <= hi <= 100:
+            raise ValueError(f"invalid quantile range {quantile_range}")
+        if unit_variance:
+            raise NotImplementedError("unit_variance rescaling is not supported (as in the reference)")
+        self.with_centering = with_centering
+        self.with_scaling = with_scaling
+        self.quantile_range = quantile_range
+        self.copy = copy
+        self.unit_variance = unit_variance
+        self.center_ = None
+        self.iqr_ = None
+
+    def fit(self, x: DNDarray) -> "RobustScaler":
+        _check_2d_float(x, "RobustScaler")
+        if self.with_centering:
+            self.center_ = ht.median(x, axis=0)
+        if self.with_scaling:
+            lo, hi = self.quantile_range
+            q_lo = ht.percentile(x, lo, axis=0)
+            q_hi = ht.percentile(x, hi, axis=0)
+            rng = q_hi - q_lo
+            self.iqr_ = ht.where(rng == 0.0, 1.0, rng)
+        return self
+
+    def transform(self, x: DNDarray) -> DNDarray:
+        _check_2d_float(x, "RobustScaler")
+        out = x
+        if self.with_centering:
+            out = out - self.center_.astype(out.dtype)
+        if self.with_scaling:
+            out = out / self.iqr_.astype(out.dtype)
+        return out
+
+    def inverse_transform(self, y: DNDarray) -> DNDarray:
+        out = y
+        if self.with_scaling:
+            out = out * self.iqr_.astype(out.dtype)
+        if self.with_centering:
+            out = out + self.center_.astype(out.dtype)
+        return out

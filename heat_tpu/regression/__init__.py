@@ -1,0 +1,4 @@
+"""Regression estimators (reference heat/regression/)."""
+
+from .lasso import *
+from . import lasso

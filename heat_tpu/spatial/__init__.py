@@ -1,0 +1,4 @@
+"""Spatial algorithms (reference heat/spatial/)."""
+
+from .distance import *
+from . import distance

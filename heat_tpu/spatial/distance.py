@@ -1,0 +1,88 @@
+"""Pairwise distances (reference heat/spatial/distance.py, 479 LoC).
+
+The reference's ``_dist`` (``distance.py:209``) is a ring algorithm: each rank holds an
+X-chunk, Y-chunks rotate around the ranks with Send/Recv, one local torch.cdist per
+step. On TPU the ring is exactly what XLA emits for the sharded pairwise computation —
+a collective-permute pipeline over the ICI torus — so ``cdist`` is a single fused
+broadcast-subtract-reduce on global arrays, with the output row-split following X.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core._operations import wrap_result
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+
+__all__ = ["cdist", "manhattan", "rbf"]
+
+
+def _pairwise(x: jax.Array, y: jax.Array, metric: str, p: float = 2.0) -> jax.Array:
+    if metric == "euclidean":
+        # |x-y|² = |x|² + |y|² - 2xy, the quadratic expansion the reference uses in
+        # _euclidian_fast (distance.py:32) — one big MXU matmul instead of O(n²d) substracts
+        xx = jnp.sum(x * x, axis=1)[:, None]
+        yy = jnp.sum(y * y, axis=1)[None, :]
+        sq = xx + yy - 2.0 * (x @ y.T)
+        return jnp.sqrt(jnp.maximum(sq, 0.0))
+    if metric == "manhattan":
+        return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    raise ValueError(f"unknown metric {metric}")
+
+
+def _dist(X: DNDarray, Y: Optional[DNDarray], metric: str) -> DNDarray:
+    """Shared driver (reference ``_dist`` ``distance.py:209``)."""
+    sanitize_in(X)
+    if X.ndim != 2:
+        raise NotImplementedError(f"X should be 2D, but is {X.ndim}D")
+    if X.split is not None and X.split != 0:
+        raise NotImplementedError("Input split was not 0")
+    promoted = types.promote_types(X.dtype, types.float32)
+    xv = X.larray.astype(promoted.jax_type())
+    if Y is None:
+        yv = xv
+        y_split = X.split
+    else:
+        sanitize_in(Y)
+        if Y.ndim != 2:
+            raise NotImplementedError(f"Y should be 2D, but is {Y.ndim}D")
+        if Y.split is not None and Y.split != 0:
+            raise NotImplementedError("Input split was not 0")
+        p2 = types.promote_types(Y.dtype, types.float32)
+        if p2 is not promoted:
+            promoted = types.promote_types(promoted, p2)
+            xv = xv.astype(promoted.jax_type())
+        yv = Y.larray.astype(promoted.jax_type())
+        y_split = Y.split
+    result = _pairwise(xv, yv, metric)
+    return wrap_result(result, X, 0 if X.split is not None else None)
+
+
+def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False) -> DNDarray:
+    """Euclidean distance matrix (reference ``distance.py:136``). The quadratic
+    expansion is always used — on the MXU it is both the fast and the natural form."""
+    return _dist(X, Y, "euclidean")
+
+
+def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -> DNDarray:
+    """City-block distance matrix (reference ``distance.py:186``)."""
+    return _dist(X, Y, "manhattan")
+
+
+def rbf(
+    X: DNDarray,
+    Y: Optional[DNDarray] = None,
+    sigma: float = 1.0,
+    quadratic_expansion: bool = False,
+) -> DNDarray:
+    """Gaussian RBF kernel matrix exp(-d²/(2σ²)) (reference ``distance.py:159``)."""
+    d = _dist(X, Y, "euclidean")
+    result = jnp.exp(-(d.larray**2) / (2.0 * sigma * sigma))
+    return wrap_result(result, d, d.split)

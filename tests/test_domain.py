@@ -1,0 +1,284 @@
+"""Domain-module tests (reference heat/cluster/tests, heat/classification/tests,
+heat/naive_bayes/tests, heat/regression/tests, heat/preprocessing/tests,
+heat/spatial/tests, heat/graph/tests)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+from heat_tpu.utils.data.spherical import create_spherical_dataset
+
+
+class TestSpatial(TestCase):
+    def test_cdist(self):
+        rng = np.random.default_rng(0)
+        a, b = rng.random((10, 3)), rng.random((7, 3))
+        expected = np.sqrt(((a[:, None, :] - b[None, :, :]) ** 2).sum(-1))
+        for split in (None, 0):
+            x, y = ht.array(a, split=split), ht.array(b, split=split)
+            d = ht.spatial.cdist(x, y)
+            np.testing.assert_allclose(d.numpy(), expected, rtol=1e-4, atol=1e-5)
+            self.assertEqual(d.split, split)
+        d = ht.spatial.cdist(ht.array(a, split=0))
+        self_expected = np.sqrt(((a[:, None, :] - a[None, :, :]) ** 2).sum(-1))
+        np.testing.assert_allclose(d.numpy(), self_expected, rtol=1e-4, atol=1e-5)
+
+    def test_manhattan_rbf(self):
+        rng = np.random.default_rng(1)
+        a, b = rng.random((6, 4)), rng.random((5, 4))
+        x, y = ht.array(a, split=0), ht.array(b)
+        expected = np.abs(a[:, None, :] - b[None, :, :]).sum(-1)
+        np.testing.assert_allclose(ht.spatial.manhattan(x, y).numpy(), expected, rtol=1e-5)
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        sigma = 2.0
+        np.testing.assert_allclose(
+            ht.spatial.rbf(x, y, sigma=sigma).numpy(), np.exp(-d2 / (2 * sigma**2)), rtol=1e-4, atol=1e-6
+        )
+
+    def test_cdist_errors(self):
+        with self.assertRaises(NotImplementedError):
+            ht.spatial.cdist(ht.ones((4, 4, 4)))
+        with self.assertRaises(NotImplementedError):
+            ht.spatial.cdist(ht.ones((4, 4), split=1))
+
+
+class TestKClustering(TestCase):
+    def _well_separated(self):
+        return create_spherical_dataset(50, radius=0.5, offset=4.0, random_state=5)
+
+    def _quality(self, labels, n_per=50):
+        # every ball maps to exactly one label
+        lab = labels.numpy()
+        groups = [set(lab[i * n_per : (i + 1) * n_per].tolist()) for i in range(4)]
+        return all(len(g) == 1 for g in groups) and len(set.union(*groups)) == 4
+
+    def test_kmeans(self):
+        x = self._well_separated()
+        km = ht.cluster.KMeans(n_clusters=4, init="kmeans++", max_iter=100, random_state=4)
+        km.fit(x)
+        self.assertEqual(km.cluster_centers_.shape, (4, 3))
+        self.assertTrue(self._quality(km.labels_), "kmeans failed to separate 4 balls")
+        self.assertLess(km.inertia_, 4 * 50 * 3 * 0.5**2 * 3)
+        pred = km.predict(x)
+        np.testing.assert_array_equal(pred.numpy(), km.labels_.numpy())
+
+    def test_kmeans_random_init_and_params(self):
+        x = self._well_separated()
+        km = ht.cluster.KMeans(n_clusters=4, init="random", random_state=11).fit(x)
+        self.assertEqual(km.cluster_centers_.shape, (4, 3))
+        params = km.get_params()
+        self.assertEqual(params["n_clusters"], 4)
+        km.set_params(n_clusters=3)
+        self.assertEqual(km.n_clusters, 3)
+
+    def test_kmeans_given_centers(self):
+        x = self._well_separated()
+        init = ht.array(np.array([[-8.0, -8, -8], [-4, -4, -4], [4, 4, 4], [8, 8, 8]], dtype=np.float32))
+        km = ht.cluster.KMeans(n_clusters=4, init=init).fit(x)
+        self.assertTrue(self._quality(km.labels_))
+        with self.assertRaises(ValueError):
+            ht.cluster.KMeans(n_clusters=3, init=init).fit(x)
+
+    def test_kmedians(self):
+        x = self._well_separated()
+        km = ht.cluster.KMedians(n_clusters=4, init=ht.array(
+            np.array([[-8.0, -8, -8], [-4, -4, -4], [4, 4, 4], [8, 8, 8]], dtype=np.float32)
+        )).fit(x)
+        self.assertTrue(self._quality(km.labels_))
+
+    def test_kmedoids(self):
+        x = self._well_separated()
+        km = ht.cluster.KMedoids(n_clusters=4, init=ht.array(
+            np.array([[-8.0, -8, -8], [-4, -4, -4], [4, 4, 4], [8, 8, 8]], dtype=np.float32)
+        )).fit(x)
+        self.assertTrue(self._quality(km.labels_))
+        # medoids are actual data points
+        c = km.cluster_centers_.numpy()
+        xn = x.numpy()
+        for row in c:
+            self.assertTrue(np.any(np.all(np.isclose(xn, row), axis=1)))
+
+    def test_batchparallel(self):
+        x = self._well_separated()
+        for cls, kw in (
+            (ht.cluster.BatchParallelKMeans, {"init": "k-means++"}),
+            (ht.cluster.BatchParallelKMedians, {"init": "k-medians++"}),
+        ):
+            bpk = cls(n_clusters=4, max_iter=50, random_state=2, **kw).fit(x)
+            self.assertEqual(bpk.cluster_centers_.shape, (4, 3))
+            self.assertTrue(self._quality(bpk.labels_), f"{cls.__name__} failed")
+        with self.assertRaises(ValueError):
+            ht.cluster.BatchParallelKMeans(init="bogus")
+        with self.assertRaises(ValueError):
+            ht.cluster.BatchParallelKMeans(n_clusters=-1)
+
+    def test_spectral(self):
+        x = create_spherical_dataset(25, radius=0.5, offset=4.0, random_state=7)
+        sp = ht.cluster.Spectral(n_clusters=4, gamma=0.1, n_lanczos=60)
+        labels = sp.fit_predict(x)
+        lab = labels.numpy()
+        groups = [set(lab[i * 25 : (i + 1) * 25].tolist()) for i in range(4)]
+        self.assertTrue(all(len(g) == 1 for g in groups))
+        self.assertEqual(len(set.union(*groups)), 4)
+
+
+class TestKNN(TestCase):
+    def test_knn(self):
+        rng = np.random.default_rng(3)
+        train = np.vstack([rng.normal(0, 0.3, (30, 2)), rng.normal(3, 0.3, (30, 2))]).astype(np.float32)
+        labels = np.concatenate([np.zeros(30, np.int64), np.ones(30, np.int64)])
+        test = np.array([[0.1, 0.0], [2.9, 3.1], [0.2, -0.1]], dtype=np.float32)
+        for split in (None, 0):
+            knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+            knn.fit(ht.array(train, split=split), ht.array(labels, split=split))
+            pred = knn.predict(ht.array(test))
+            np.testing.assert_array_equal(pred.numpy(), [0, 1, 0])
+
+    def test_one_hot(self):
+        enc = ht.classification.KNeighborsClassifier.one_hot_encoding(ht.array(np.array([0, 2, 1])))
+        np.testing.assert_array_equal(enc.numpy(), [[1, 0, 0], [0, 0, 1], [0, 1, 0]])
+
+
+class TestGaussianNB(TestCase):
+    def _data(self):
+        rng = np.random.default_rng(4)
+        x0 = rng.normal(0, 1, (40, 3))
+        x1 = rng.normal(5, 1, (40, 3))
+        x = np.vstack([x0, x1]).astype(np.float64)
+        y = np.concatenate([np.zeros(40, np.int64), np.ones(40, np.int64)])
+        return x, y
+
+    def test_fit_predict(self):
+        x, y = self._data()
+        for split in (None, 0):
+            nb = ht.naive_bayes.GaussianNB()
+            nb.fit(ht.array(x, split=split), ht.array(y, split=split))
+            pred = nb.predict(ht.array(x, split=split))
+            acc = (pred.numpy() == y).mean()
+            self.assertGreater(acc, 0.95)
+            proba = nb.predict_proba(ht.array(x[:5]))
+            np.testing.assert_allclose(proba.numpy().sum(axis=1), 1.0, rtol=1e-6)
+
+    def test_partial_fit_matches_fit(self):
+        x, y = self._data()
+        full = ht.naive_bayes.GaussianNB().fit(ht.array(x), ht.array(y))
+        inc = ht.naive_bayes.GaussianNB()
+        inc.partial_fit(ht.array(x[:30]), ht.array(y[:30]), classes=ht.array(np.array([0, 1])))
+        inc.partial_fit(ht.array(x[30:]), ht.array(y[30:]))
+        np.testing.assert_allclose(np.asarray(full.theta_), np.asarray(inc.theta_), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(full.var_), np.asarray(inc.var_), rtol=1e-5)
+
+    def test_priors_validation(self):
+        x, y = self._data()
+        nb = ht.naive_bayes.GaussianNB(priors=ht.array(np.array([0.7, 0.4])))
+        with self.assertRaises(ValueError):
+            nb.fit(ht.array(x), ht.array(y))
+
+
+class TestLasso(TestCase):
+    def test_lasso_recovers_sparse(self):
+        rng = np.random.default_rng(5)
+        n, d = 100, 6
+        X = rng.normal(0, 1, (n, d))
+        theta_true = np.array([0.0, 2.0, 0.0, -3.0, 0.0, 0.0])
+        y = X @ theta_true + 0.01 * rng.normal(size=n)
+        Xi = np.hstack([np.ones((n, 1)), X])  # leading intercept column
+        for split in (None, 0):
+            lasso = ht.regression.Lasso(lam=0.05, max_iter=200)
+            lasso.fit(ht.array(Xi, split=split), ht.array(y, split=split))
+            coef = lasso.theta.numpy().reshape(-1)[1:]
+            np.testing.assert_allclose(coef, theta_true, atol=0.1)
+            pred = lasso.predict(ht.array(Xi, split=split))
+            rmse = float(lasso.rmse(ht.array(y).reshape((n, 1)), pred).item())
+            self.assertLess(rmse, 0.2)
+
+    def test_soft_threshold(self):
+        lasso = ht.regression.Lasso(lam=1.0)
+        out = lasso.soft_threshold(ht.array(np.array([-2.0, -0.5, 0.5, 2.0])))
+        np.testing.assert_allclose(out.numpy(), [-1.0, 0.0, 0.0, 1.0])
+
+
+class TestPreprocessing(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(6)
+        self.a = (rng.random((20, 4)) * 10 - 3).astype(np.float64)
+
+    def test_standard_scaler(self):
+        for split in (None, 0):
+            x = ht.array(self.a, split=split)
+            sc = ht.preprocessing.StandardScaler()
+            t = sc.fit_transform(x)
+            np.testing.assert_allclose(t.numpy().mean(axis=0), 0.0, atol=1e-10)
+            np.testing.assert_allclose(t.numpy().std(axis=0), 1.0, rtol=1e-6)
+            back = sc.inverse_transform(t)
+            np.testing.assert_allclose(back.numpy(), self.a, rtol=1e-6)
+
+    def test_minmax_scaler(self):
+        x = ht.array(self.a, split=0)
+        sc = ht.preprocessing.MinMaxScaler(feature_range=(-1.0, 1.0))
+        t = sc.fit_transform(x)
+        np.testing.assert_allclose(t.numpy().min(axis=0), -1.0, atol=1e-7)
+        np.testing.assert_allclose(t.numpy().max(axis=0), 1.0, atol=1e-7)
+        np.testing.assert_allclose(sc.inverse_transform(t).numpy(), self.a, rtol=1e-5, atol=1e-6)
+        with self.assertRaises(ValueError):
+            ht.preprocessing.MinMaxScaler(feature_range=(1.0, 0.0))
+
+    def test_normalizer(self):
+        x = ht.array(self.a, split=0)
+        for norm, check in (
+            ("l2", lambda v: np.linalg.norm(v, axis=1)),
+            ("l1", lambda v: np.abs(v).sum(axis=1)),
+            ("max", lambda v: np.abs(v).max(axis=1)),
+        ):
+            t = ht.preprocessing.Normalizer(norm=norm).fit_transform(x)
+            np.testing.assert_allclose(check(t.numpy()), 1.0, rtol=1e-6)
+
+    def test_maxabs_robust(self):
+        x = ht.array(self.a, split=0)
+        t = ht.preprocessing.MaxAbsScaler().fit_transform(x)
+        self.assertLessEqual(float(np.abs(t.numpy()).max()), 1.0 + 1e-7)
+        rs = ht.preprocessing.RobustScaler()
+        t = rs.fit_transform(x)
+        np.testing.assert_allclose(np.median(t.numpy(), axis=0), 0.0, atol=1e-7)
+        np.testing.assert_allclose(rs.inverse_transform(t).numpy(), self.a, rtol=1e-5, atol=1e-6)
+
+
+class TestGraph(TestCase):
+    def test_laplacian_simple(self):
+        rng = np.random.default_rng(7)
+        pts = rng.random((12, 2)).astype(np.float32)
+        x = ht.array(pts, split=0)
+        lap = ht.graph.Laplacian(lambda y: ht.spatial.cdist(y), definition="simple",
+                                 mode="eNeighbour", threshold_value=0.5)
+        L = lap.construct(x)
+        Ln = L.numpy()
+        np.testing.assert_allclose(Ln.sum(axis=1), 0.0, atol=1e-4)  # row sums vanish
+        self.assertTrue((np.diag(Ln) >= 0).all())
+
+    def test_laplacian_norm_sym(self):
+        rng = np.random.default_rng(8)
+        pts = rng.random((10, 2)).astype(np.float32)
+        x = ht.array(pts, split=0)
+        lap = ht.graph.Laplacian(lambda y: ht.spatial.rbf(y, sigma=1.0), definition="norm_sym")
+        L = lap.construct(x)
+        Ln = L.numpy()
+        np.testing.assert_allclose(np.diag(Ln), 1.0, atol=1e-5)
+        np.testing.assert_allclose(Ln, Ln.T, atol=1e-5)
+        ev = np.linalg.eigvalsh(Ln)
+        self.assertGreater(ev.min(), -1e-5)
+
+    def test_base_predicates(self):
+        km = ht.cluster.KMeans()
+        self.assertTrue(ht.core.base.is_estimator(km))
+        self.assertTrue(ht.core.base.is_clusterer(km))
+        self.assertFalse(ht.core.base.is_classifier(km))
+        knn = ht.classification.KNeighborsClassifier()
+        self.assertTrue(ht.core.base.is_classifier(knn))
+        self.assertTrue(ht.core.base.is_regressor(ht.regression.Lasso()))
+        self.assertTrue(ht.core.base.is_transformer(ht.preprocessing.StandardScaler()))
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

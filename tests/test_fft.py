@@ -1,0 +1,90 @@
+"""FFT tests (reference heat/fft/tests/test_fft.py): parity against numpy.fft with the
+split sweep over every axis."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestFFT(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(0)
+        self.real = rng.random((8, 10)).astype(np.float64)
+        self.cplx = (rng.random((8, 10)) + 1j * rng.random((8, 10))).astype(np.complex128)
+
+    def _sweep(self, ht_fn, np_fn, a, **kw):
+        expected = np_fn(a, **kw)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            got = ht_fn(x, **kw)
+            np.testing.assert_allclose(got.numpy(), expected, rtol=1e-6, atol=1e-8,
+                                       err_msg=f"split={split}")
+            self.assertEqual(got.split, split)
+
+    def test_fft_ifft(self):
+        self._sweep(ht.fft.fft, np.fft.fft, self.cplx)
+        self._sweep(ht.fft.fft, np.fft.fft, self.cplx, axis=0)
+        self._sweep(ht.fft.fft, np.fft.fft, self.cplx, n=16)
+        self._sweep(ht.fft.ifft, np.fft.ifft, self.cplx)
+        self._sweep(ht.fft.fft, np.fft.fft, self.cplx, norm="ortho")
+
+    def test_fft2_fftn(self):
+        self._sweep(ht.fft.fft2, np.fft.fft2, self.cplx)
+        self._sweep(ht.fft.ifft2, np.fft.ifft2, self.cplx)
+        self._sweep(ht.fft.fftn, np.fft.fftn, self.cplx)
+        self._sweep(ht.fft.ifftn, np.fft.ifftn, self.cplx)
+        a3 = np.random.default_rng(1).random((4, 6, 8))
+        self._sweep(ht.fft.fftn, np.fft.fftn, a3.astype(np.complex128), axes=(0, 2))
+
+    def test_rfft_family(self):
+        self._sweep(ht.fft.rfft, np.fft.rfft, self.real)
+        self._sweep(ht.fft.rfft, np.fft.rfft, self.real, axis=0)
+        self._sweep(ht.fft.rfft2, np.fft.rfft2, self.real)
+        self._sweep(ht.fft.rfftn, np.fft.rfftn, self.real)
+        spec = np.fft.rfft(self.real)
+        self._sweep(ht.fft.irfft, np.fft.irfft, spec)
+        self._sweep(ht.fft.irfft2, np.fft.irfft2, np.fft.rfft2(self.real))
+        self._sweep(ht.fft.irfftn, np.fft.irfftn, np.fft.rfftn(self.real))
+        with self.assertRaises(TypeError):
+            ht.fft.rfft(ht.array(self.cplx))
+
+    def test_hfft_family(self):
+        self._sweep(ht.fft.hfft, np.fft.hfft, self.cplx)
+        self._sweep(ht.fft.ihfft, np.fft.ihfft, self.real)
+        # hfftn/ihfftn round-trip (torch semantics; numpy lacks nd variants)
+        x = ht.array(self.real, split=0)
+        back = ht.fft.hfftn(ht.fft.ihfftn(x), s=self.real.shape)
+        np.testing.assert_allclose(back.numpy(), self.real, rtol=1e-6, atol=1e-9)
+        # hfft2 of a 1-axis-hermitian signal matches hfft along last axis after fft on 0
+        y = ht.fft.ihfftn(x, axes=(1,))
+        np.testing.assert_allclose(
+            ht.fft.hfftn(y, s=(self.real.shape[1],), axes=(1,)).numpy(),
+            self.real, rtol=1e-6, atol=1e-9,
+        )
+
+    def test_freq_shift(self):
+        np.testing.assert_allclose(ht.fft.fftfreq(10, d=0.1).numpy(), np.fft.fftfreq(10, d=0.1))
+        np.testing.assert_allclose(ht.fft.rfftfreq(10, d=0.1).numpy(), np.fft.rfftfreq(10, d=0.1))
+        self._sweep(ht.fft.fftshift, np.fft.fftshift, self.real)
+        self._sweep(ht.fft.ifftshift, np.fft.ifftshift, self.real)
+        a = np.fft.fftfreq(9)
+        np.testing.assert_allclose(
+            ht.fft.fftshift(ht.array(a, split=0), axes=0).numpy(), np.fft.fftshift(a, axes=0)
+        )
+
+    def test_roundtrips(self):
+        for split in (None, 0, 1):
+            x = ht.array(self.cplx, split=split)
+            np.testing.assert_allclose(
+                ht.fft.ifft(ht.fft.fft(x)).numpy(), self.cplx, rtol=1e-6, atol=1e-10
+            )
+            np.testing.assert_allclose(
+                ht.fft.ifftn(ht.fft.fftn(x)).numpy(), self.cplx, rtol=1e-6, atol=1e-10
+            )
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
