@@ -33,3 +33,5 @@ from . import naive_bayes
 from . import regression
 from . import preprocessing
 from . import graph
+from . import datasets
+from . import sparse

@@ -40,6 +40,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         self.random_state = random_state
 
         self._metric = metric
+        self._seed_p = 2  # metric exponent for ++ seeding (1 = manhattan)
         self._cluster_centers = None
         self._labels = None
         self._inertia = None
@@ -90,7 +91,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
 
             xv = x.larray.astype(jnp.float32)
             key = _jax.random.key(int(ht.random.randint(0, 2**31 - 1, (1,)).item()))
-            centers = _plus_plus(xv, k, 2, key)
+            centers = _plus_plus(xv, k, self._seed_p, key)
             self._cluster_centers = ht.array(centers.astype(x.larray.dtype), comm=x.comm)
             return
         if self.init == "batchparallel":
@@ -114,7 +115,23 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         raise NotImplementedError()
 
     def fit(self, x: DNDarray):
-        raise NotImplementedError()
+        """Shared Lloyd-style iteration (reference duplicates this across
+        kmeans.py:105/kmedians.py:101/kmedoids.py:118): assign, update, converge when
+        the squared centroid shift drops to ``tol``."""
+        if not isinstance(x, DNDarray):
+            raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
+        self._initialize_cluster_centers(x)
+        self._n_iter = 0
+        for _ in range(self.max_iter):
+            matching_centroids = self._assign_to_cluster(x)
+            new_centers = self._update_centroids(x, matching_centroids)
+            self._n_iter += 1
+            shift = float(ht.sum((self._cluster_centers - new_centers) ** 2).item())
+            self._cluster_centers = new_centers
+            if shift <= self.tol:
+                break
+        self._labels = self._assign_to_cluster(x, eval_functional_value=True)
+        return self
 
     def predict(self, x: DNDarray) -> DNDarray:
         """Nearest learned centroid for each sample (reference ``_kcluster.py:298``)."""

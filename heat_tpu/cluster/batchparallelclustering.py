@@ -57,11 +57,9 @@ def _kmex(X: jax.Array, p: int, n_clusters: int, init, max_iter: int, tol: float
 
 
 def _cdist_p(x: jax.Array, y: jax.Array, p: int) -> jax.Array:
-    if p == 1:
-        return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
-    xx = jnp.sum(x * x, axis=1)[:, None]
-    yy = jnp.sum(y * y, axis=1)[None, :]
-    return jnp.sqrt(jnp.maximum(xx + yy - 2.0 * (x @ y.T), 0.0))
+    from ..spatial.distance import _pairwise
+
+    return _pairwise(x, y, "manhattan" if p == 1 else "euclidean")
 
 
 def _plus_plus(X: jax.Array, k: int, p: int, key) -> jax.Array:
@@ -153,7 +151,10 @@ class _BatchParallelKCluster(ClusteringMixin, BaseEstimator):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
         if x.split != 0:
             raise ValueError(f"input needs to be split along the sample axis (split=0), but was {x.split}")
-        key = jax.random.key(self.random_state if self.random_state is not None else 0)
+        seed = self.random_state if self.random_state is not None else int(
+            ht.random.randint(0, 2**31 - 1, (1,)).item()
+        )
+        key = jax.random.key(seed)
         xv = x.larray.astype(jnp.float32) if x.dtype not in (ht.float32, ht.float64) else x.larray
 
         # local batches = the canonical shard blocks

@@ -35,6 +35,7 @@ class KMedians(_KCluster):
             tol=tol,
             random_state=random_state,
         )
+        self._seed_p = 1  # seed with the manhattan metric the estimator optimizes
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
         """Coordinate-wise median per cluster (reference ``kmedians.py:71-99``)."""
@@ -51,19 +52,3 @@ class KMedians(_KCluster):
             new_rows.append(jnp.where(cnt > 0, med.astype(old.dtype), old[c]))
         return ht.array(jnp.stack(new_rows), comm=x.comm)
 
-    def fit(self, x: DNDarray) -> "KMedians":
-        """Cluster ``x`` (reference ``kmedians.py:101``)."""
-        if not isinstance(x, DNDarray):
-            raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
-        self._initialize_cluster_centers(x)
-        self._n_iter = 0
-        for epoch in range(self.max_iter):
-            matching_centroids = self._assign_to_cluster(x)
-            new_centers = self._update_centroids(x, matching_centroids)
-            self._n_iter += 1
-            shift = float(ht.sum((self._cluster_centers - new_centers) ** 2).item())
-            self._cluster_centers = new_centers
-            if shift <= self.tol:
-                break
-        self._labels = self._assign_to_cluster(x, eval_functional_value=True)
-        return self

@@ -54,19 +54,3 @@ class KMedoids(_KCluster):
         snapped = jnp.where(counts[:, None] > 0, snapped, old)
         return ht.array(snapped, comm=x.comm)
 
-    def fit(self, x: DNDarray) -> "KMedoids":
-        """Cluster ``x`` (reference ``kmedoids.py:118``)."""
-        if not isinstance(x, DNDarray):
-            raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
-        self._initialize_cluster_centers(x)
-        self._n_iter = 0
-        for epoch in range(self.max_iter):
-            matching_centroids = self._assign_to_cluster(x)
-            new_centers = self._update_centroids(x, matching_centroids)
-            self._n_iter += 1
-            shift = float(ht.sum((self._cluster_centers - new_centers) ** 2).item())
-            self._cluster_centers = new_centers
-            if shift == 0.0:
-                break
-        self._labels = self._assign_to_cluster(x, eval_functional_value=True)
-        return self

@@ -22,6 +22,8 @@ from .indexing import *
 from .signal import *
 from .tiling import *
 from .base import *
+from .io import *
+from . import io
 from . import random
 from . import linalg
 from .linalg import *  # promoted to the flat namespace like the reference

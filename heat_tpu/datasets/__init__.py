@@ -1,0 +1,35 @@
+"""Packaged sample datasets (reference heat/datasets/: iris/diabetes files used by
+tests and demos). Files here are synthesized deterministically by :func:`generate` at
+build/test time rather than shipped as binary blobs."""
+
+import os
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def path(name: str) -> str:
+    """Absolute path of a packaged dataset file, generating it on first use."""
+    p = os.path.join(_DIR, name)
+    if not os.path.exists(p):
+        generate()
+    return p
+
+
+def generate() -> None:
+    """Create the sample data files: a 150x4 'flowers' table (iris-shaped: three
+    100-per-class gaussian clusters) as CSV and HDF5."""
+    rng = np.random.default_rng(20260729)
+    blocks = []
+    for center in ((5.0, 3.4, 1.5, 0.2), (5.9, 2.8, 4.3, 1.3), (6.6, 3.0, 5.6, 2.0)):
+        blocks.append(rng.normal(center, 0.3, size=(50, 4)))
+    data = np.vstack(blocks).astype(np.float32)
+    np.savetxt(os.path.join(_DIR, "flowers.csv"), data, delimiter=";", fmt="%.4f")
+    try:
+        import h5py
+
+        with h5py.File(os.path.join(_DIR, "flowers.h5"), "w") as f:
+            f.create_dataset("data", data=data)
+    except ImportError:
+        pass

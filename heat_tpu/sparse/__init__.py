@@ -1,0 +1,7 @@
+"""Distributed sparse matrices (reference heat/sparse/)."""
+
+from .arithmetics import *
+from .dcsr_matrix import *
+from .factories import *
+from .manipulations import *
+from . import arithmetics, dcsr_matrix, factories, manipulations

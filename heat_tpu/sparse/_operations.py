@@ -1,0 +1,61 @@
+"""Sparse dispatch (reference heat/sparse/_operations.py, 116 LoC)."""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core import types
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["binary_op_csr"]
+
+
+def binary_op_csr(operation: Callable, t1: DCSR_matrix, t2) -> DCSR_matrix:
+    """Elementwise op between sparse operands (reference ``__binary_op_csr``
+    ``_operations.py:18``). Sparse×sparse unions the sparsity patterns; scalar operands
+    act on stored values only (matching torch/scipy CSR semantics for mul)."""
+    if not isinstance(t1, DCSR_matrix):
+        raise TypeError(f"first operand must be a DCSR_matrix, got {type(t1)}")
+    if isinstance(t2, DCSR_matrix):
+        if t1.shape != t2.shape:
+            raise ValueError(f"shapes {t1.shape} and {t2.shape} do not match")
+        # O(nnz) index-union merge — never densify (the arrays this type exists for
+        # would not fit dense)
+        ncols = t1.shape[1]
+        k1 = np.asarray(t1.larray.indices) @ np.array([ncols, 1], dtype=np.int64)
+        k2 = np.asarray(t2.larray.indices) @ np.array([ncols, 1], dtype=np.int64)
+        v1 = np.asarray(t1.larray.data)
+        v2 = np.asarray(t2.larray.data)
+        union = np.union1d(k1, k2)
+        a = np.zeros(len(union), dtype=np.result_type(v1.dtype, v2.dtype))
+        b = np.zeros_like(a)
+        pos1 = np.searchsorted(union, k1)
+        pos2 = np.searchsorted(union, k2)
+        np.add.at(a, pos1, v1)  # duplicate indices accumulate, like sum_duplicates
+        np.add.at(b, pos2, v2)
+        vals = np.asarray(operation(jnp.asarray(a), jnp.asarray(b)))
+        keep = vals != 0
+        union, vals = union[keep], vals[keep]
+        idx = np.stack([union // ncols, union % ncols], axis=1)
+        bcoo = jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)), shape=t1.shape)
+    elif np.isscalar(t2):
+        a = t1.larray
+        bcoo = jsparse.BCOO((operation(a.data, t2), a.indices), shape=a.shape)
+    else:
+        raise TypeError(f"unsupported operand type {type(t2)}")
+    dtype = types.canonical_heat_type(bcoo.data.dtype)
+    return DCSR_matrix(
+        array=bcoo,
+        gnnz=int(bcoo.nse),
+        gshape=t1.shape,
+        dtype=dtype,
+        split=t1.split,
+        device=t1.device,
+        comm=t1.comm,
+        balanced=t1.balanced,
+    )

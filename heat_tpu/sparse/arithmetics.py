@@ -1,0 +1,26 @@
+"""Elementwise sparse arithmetic (reference heat/sparse/arithmetics.py, 98 LoC)."""
+
+from __future__ import annotations
+
+from typing import Union
+
+import numpy as np
+
+from .dcsr_matrix import DCSR_matrix
+from ._operations import binary_op_csr
+
+__all__ = ["add", "mul"]
+
+
+def add(t1: DCSR_matrix, t2: Union[DCSR_matrix, float, int]) -> DCSR_matrix:
+    """Elementwise sum (reference ``arithmetics.py:17``)."""
+    import jax.numpy as jnp
+
+    return binary_op_csr(jnp.add, t1, t2)
+
+
+def mul(t1: DCSR_matrix, t2: Union[DCSR_matrix, float, int]) -> DCSR_matrix:
+    """Elementwise product (reference ``arithmetics.py:55``)."""
+    import jax.numpy as jnp
+
+    return binary_op_csr(jnp.multiply, t1, t2)

@@ -1,0 +1,77 @@
+"""Sparse factories (reference heat/sparse/factories.py, 220 LoC)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core import types
+from ..core.communication import sanitize_comm
+from ..core.devices import sanitize_device
+from ..core.dndarray import DNDarray
+from .dcsr_matrix import DCSR_matrix
+
+__all__ = ["sparse_csr_matrix"]
+
+
+def sparse_csr_matrix(
+    obj,
+    dtype=None,
+    copy: bool = True,
+    ndmin: int = 0,
+    order: str = "C",
+    split: Optional[int] = None,
+    is_split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DCSR_matrix:
+    """Build a DCSR_matrix from dense/sparse input (reference ``factories.py:23``).
+
+    Accepts dense arrays/DNDarrays, scipy CSR matrices, torch sparse CSR tensors, and
+    BCOO values. Only row-split (``split=0``) or replicated layouts exist, like the
+    reference.
+    """
+    comm = sanitize_comm(comm)
+    device = sanitize_device(device)
+    if split not in (None, 0) or is_split not in (None, 0):
+        raise ValueError("DCSR matrices support split=0 or None only")
+
+    if isinstance(obj, DCSR_matrix):
+        bcoo = obj.larray
+    elif isinstance(obj, jsparse.BCOO):
+        bcoo = obj
+    elif isinstance(obj, DNDarray):
+        bcoo = jsparse.BCOO.fromdense(obj.larray)
+    else:
+        # scipy / torch sparse inputs expose dense conversion
+        if hasattr(obj, "toarray"):
+            dense = np.asarray(obj.toarray())
+        elif hasattr(obj, "to_dense"):
+            dense = np.asarray(obj.to_dense())
+        else:
+            dense = np.asarray(obj)
+        bcoo = jsparse.BCOO.fromdense(jnp.asarray(dense))
+
+    if bcoo.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix, got {bcoo.ndim}-D")
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+        bcoo = jsparse.BCOO((bcoo.data.astype(dtype.jax_type()), bcoo.indices), shape=bcoo.shape)
+    else:
+        dtype = types.canonical_heat_type(bcoo.data.dtype)
+
+    split = split if split is not None else is_split
+    return DCSR_matrix(
+        array=bcoo,
+        gnnz=int(bcoo.nse),
+        gshape=tuple(bcoo.shape),
+        dtype=dtype,
+        split=split,
+        device=device,
+        comm=comm,
+        balanced=True,
+    )

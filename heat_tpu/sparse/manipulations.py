@@ -1,0 +1,32 @@
+"""Sparse conversions (reference heat/sparse/manipulations.py, 84 LoC)."""
+
+from __future__ import annotations
+
+from ..core.dndarray import DNDarray
+from .dcsr_matrix import DCSR_matrix
+from .factories import sparse_csr_matrix
+
+__all__ = ["to_dense", "to_sparse"]
+
+
+def to_dense(sparse_matrix: DCSR_matrix, order: str = "C", out=None) -> DNDarray:
+    """Dense DNDarray from a DCSR matrix (reference ``manipulations.py:53``)."""
+    from ..core import factories
+
+    dense = sparse_matrix.larray.todense()
+    res = factories.array(
+        dense,
+        dtype=sparse_matrix.dtype,
+        split=sparse_matrix.split,
+        device=sparse_matrix.device,
+        comm=sparse_matrix.comm,
+    )
+    if out is not None:
+        out.larray = out.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
+        return out
+    return res
+
+
+def to_sparse(array: DNDarray) -> DCSR_matrix:
+    """DCSR matrix from a dense DNDarray (reference ``manipulations.py:17``)."""
+    return sparse_csr_matrix(array, split=array.split)

@@ -48,7 +48,6 @@ def _dist(X: DNDarray, Y: Optional[DNDarray], metric: str) -> DNDarray:
     xv = X.larray.astype(promoted.jax_type())
     if Y is None:
         yv = xv
-        y_split = X.split
     else:
         sanitize_in(Y)
         if Y.ndim != 2:
@@ -60,7 +59,6 @@ def _dist(X: DNDarray, Y: Optional[DNDarray], metric: str) -> DNDarray:
             promoted = types.promote_types(promoted, p2)
             xv = xv.astype(promoted.jax_type())
         yv = Y.larray.astype(promoted.jax_type())
-        y_split = Y.split
     result = _pairwise(xv, yv, metric)
     return wrap_result(result, X, 0 if X.split is not None else None)
 

@@ -1,0 +1,86 @@
+"""I/O tests (reference heat/core/tests/test_io.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestIO(TestCase):
+    def setUp(self):
+        self.tmp = tempfile.mkdtemp()
+        rng = np.random.default_rng(0)
+        self.data = rng.random((12, 5)).astype(np.float32)
+
+    def test_csv_roundtrip(self):
+        p = os.path.join(self.tmp, "x.csv")
+        for split in (None, 0):
+            x = ht.array(self.data, split=split)
+            ht.save(x, p, decimals=7)
+            back = ht.load(p, split=split)
+            np.testing.assert_allclose(back.numpy(), self.data, rtol=1e-5)
+            self.assertEqual(back.split, split)
+
+    def test_csv_header(self):
+        p = os.path.join(self.tmp, "h.csv")
+        ht.save_csv(ht.array(self.data), p, header_lines=["a,b,c,d,e"], decimals=5)
+        back = ht.load_csv(p, header_lines=1)
+        np.testing.assert_allclose(back.numpy(), self.data, atol=1e-5)
+
+    def test_hdf5_roundtrip(self):
+        if not ht.io.supports_hdf5():
+            self.skipTest("h5py not available")
+        p = os.path.join(self.tmp, "x.h5")
+        for split in (None, 0, 1):
+            x = ht.array(self.data, split=split)
+            ht.save(x, p, "data")
+            back = ht.load(p, dataset="data", split=split)
+            np.testing.assert_allclose(back.numpy(), self.data, rtol=1e-6)
+            self.assertEqual(back.split, split)
+
+    def test_hdf5_load_fraction(self):
+        if not ht.io.supports_hdf5():
+            self.skipTest("h5py not available")
+        p = os.path.join(self.tmp, "f.h5")
+        ht.save_hdf5(ht.array(self.data), p, "data")
+        back = ht.load_hdf5(p, "data", load_fraction=0.5, split=0)
+        self.assertEqual(back.gshape[0], 6)
+        np.testing.assert_allclose(back.numpy(), self.data[:6], rtol=1e-6)
+
+    def test_npy_roundtrip(self):
+        p = os.path.join(self.tmp, "x.npy")
+        ht.save(ht.array(self.data, split=0), p)
+        back = ht.load(p, split=1)
+        np.testing.assert_allclose(back.numpy(), self.data, rtol=1e-6)
+
+    def test_errors(self):
+        with self.assertRaises(ValueError):
+            ht.load(os.path.join(self.tmp, "x.bogus"))
+        with self.assertRaises(TypeError):
+            ht.load(42)
+        with self.assertRaises(TypeError):
+            ht.save(np.zeros(3), os.path.join(self.tmp, "x.csv"))
+        with self.assertRaises(ValueError):
+            ht.save_csv(ht.ones((2, 2, 2)), os.path.join(self.tmp, "x.csv"))
+        if ht.io.supports_hdf5():
+            with self.assertRaises(ValueError):
+                ht.load_hdf5(os.path.join(self.tmp, "x.h5"), "data", load_fraction=0.0)
+
+    def test_packaged_dataset(self):
+        from heat_tpu import datasets
+
+        p = datasets.path("flowers.csv")
+        x = ht.load_csv(p, sep=";", split=0)
+        self.assertEqual(tuple(x.shape), (150, 4))
+        if ht.io.supports_hdf5():
+            h = ht.load(datasets.path("flowers.h5"), dataset="data", split=0)
+            np.testing.assert_allclose(h.numpy(), x.numpy(), rtol=1e-3, atol=1e-4)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -1,0 +1,87 @@
+"""Sparse tests (reference heat/sparse/tests/)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+def _sample(seed=0, shape=(8, 6), density=0.3):
+    rng = np.random.default_rng(seed)
+    dense = rng.random(shape) * (rng.random(shape) < density)
+    return dense.astype(np.float32)
+
+
+class TestSparse(TestCase):
+    def test_factory_from_dense(self):
+        dense = _sample()
+        for split in (None, 0):
+            s = ht.sparse.sparse_csr_matrix(ht.array(dense, split=split), split=split)
+            self.assertEqual(s.shape, dense.shape)
+            self.assertEqual(s.split, split)
+            self.assertEqual(s.nnz, int((dense != 0).sum()))
+            np.testing.assert_allclose(s.numpy(), dense, rtol=1e-6)
+
+    def test_csr_views(self):
+        dense = _sample(1)
+        s = ht.sparse.sparse_csr_matrix(ht.array(dense), split=0)
+        try:
+            from scipy import sparse as sp
+
+            ref = sp.csr_matrix(dense)
+            np.testing.assert_array_equal(np.asarray(s.indptr), ref.indptr)
+            np.testing.assert_array_equal(np.asarray(s.indices), ref.indices)
+            np.testing.assert_allclose(np.asarray(s.data), ref.data, rtol=1e-6)
+        except ImportError:
+            indptr = np.asarray(s.indptr)
+            self.assertEqual(indptr[0], 0)
+            self.assertEqual(indptr[-1], s.nnz)
+        # local views cover a prefix of rows
+        lptr = np.asarray(s.lindptr)
+        self.assertEqual(lptr[0], 0)
+        self.assertEqual(len(np.asarray(s.ldata)), lptr[-1])
+        self.assertEqual(s.lshape[1], dense.shape[1])
+
+    def test_add_mul_sparse(self):
+        a, b = _sample(2), _sample(3)
+        sa = ht.sparse.sparse_csr_matrix(ht.array(a), split=0)
+        sb = ht.sparse.sparse_csr_matrix(ht.array(b), split=0)
+        np.testing.assert_allclose((sa + sb).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose(ht.sparse.mul(sa, sb).numpy(), a * b, rtol=1e-6)
+
+    def test_scalar_ops(self):
+        a = _sample(4)
+        sa = ht.sparse.sparse_csr_matrix(ht.array(a))
+        # scalar ops act on stored values (torch/scipy CSR semantics)
+        prod = ht.sparse.mul(sa, 2.0)
+        np.testing.assert_allclose(prod.numpy(), a * 2.0, rtol=1e-6)
+        self.assertEqual(prod.nnz, sa.nnz)
+
+    def test_to_dense_to_sparse(self):
+        a = _sample(5)
+        x = ht.array(a, split=0)
+        s = ht.sparse.to_sparse(x)
+        self.assertEqual(s.split, 0)
+        d = ht.sparse.to_dense(s)
+        self.assertEqual(d.split, 0)
+        np.testing.assert_allclose(d.numpy(), a, rtol=1e-6)
+        self.assert_array_equal(s.todense(), a)
+
+    def test_astype_and_errors(self):
+        a = _sample(6)
+        s = ht.sparse.sparse_csr_matrix(ht.array(a))
+        d = s.astype(ht.float64)
+        self.assertEqual(d.dtype, ht.float64)
+        with self.assertRaises(ValueError):
+            ht.sparse.sparse_csr_matrix(ht.array(a), split=1)
+        with self.assertRaises(ValueError):
+            b = ht.sparse.sparse_csr_matrix(ht.array(_sample(7, shape=(4, 4))))
+            ht.sparse.add(s, b)
+        with self.assertRaises(TypeError):
+            ht.sparse.add(np.zeros((2, 2)), s)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
