@@ -1,0 +1,22 @@
+"""K-clustering demo (reference examples/cluster/demo_kClustering.py): fit
+KMeans/KMedians/KMedoids on the spherical fixture and report inertia."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import heat_tpu as ht
+from heat_tpu.utils.data.spherical import create_spherical_dataset
+
+
+def main():
+    data = create_spherical_dataset(num_samples_cluster=250, radius=1.0, offset=4.0, random_state=1)
+    for cls in (ht.cluster.KMeans, ht.cluster.KMedians, ht.cluster.KMedoids):
+        est = cls(n_clusters=4, init="probability_based", random_state=2)
+        est.fit(data)
+        print(f"{cls.__name__}: n_iter={est.n_iter_} inertia={est.inertia_:.2f}")
+
+
+if __name__ == "__main__":
+    main()

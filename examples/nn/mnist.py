@@ -1,0 +1,62 @@
+"""Data-parallel MLP classifier (reference examples/nn/mnist.py — north-star config #5).
+
+The reference launches under ``mpirun -np N`` and wraps a torch CNN in
+``ht.nn.DataParallel`` with gradient-Allreduce hooks. Here the batch is one global
+split-0 DNDarray over the TPU mesh and the whole training step is a single XLA program.
+
+Runs on real MNIST when a torchvision copy exists locally; falls back to a synthetic
+digits-like dataset so the example is always runnable.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import heat_tpu as ht
+
+
+def get_data(n=2048, d=784, classes=10, seed=0):
+    try:
+        from heat_tpu.utils.data.mnist import MNISTDataset
+
+        ds = MNISTDataset("data", train=True)
+        x = ds.htdata.reshape((len(ds), 784)).astype(ht.float32)
+        return x, ds.httargets
+    except Exception:
+        rng = np.random.default_rng(seed)
+        centers = rng.normal(0, 1.0, (classes, d)).astype(np.float32)
+        y = rng.integers(0, classes, n)
+        x = centers[y] + rng.normal(0, 0.7, (n, d)).astype(np.float32)
+        return ht.array(x, split=0), ht.array(y.astype(np.int64), split=0)
+
+
+def main(epochs=5, batch_size=256, lr=0.1):
+    x, y = get_data()
+    dataset = ht.utils.data.Dataset(x, y, test_set=False)
+    loader = ht.utils.data.DataLoader(dataset, batch_size=batch_size)
+
+    model = ht.nn.Sequential(
+        ht.nn.Linear(x.gshape[1], 128), ht.nn.ReLU(), ht.nn.Linear(128, 10)
+    )
+    optimizer = ht.optim.DataParallelOptimizer("sgd", lr=lr)
+    dp_model = ht.nn.DataParallel(model, optimizer=optimizer)
+    criterion = ht.nn.CrossEntropyLoss()
+
+    def loss_fn(params, xb, yb):
+        return criterion(model.apply(params, xb), yb)
+
+    for epoch in range(epochs):
+        total, nb = 0.0, 0
+        for xb, yb in loader:
+            total += optimizer.step(loss_fn, xb, yb)
+            nb += 1
+        pred = np.argmax(dp_model(x).numpy(), axis=1)
+        acc = (pred == y.numpy()).mean()
+        print(f"epoch {epoch}: loss={total / max(nb, 1):.4f} acc={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -35,3 +35,5 @@ from . import preprocessing
 from . import graph
 from . import datasets
 from . import sparse
+from . import nn
+from . import optim

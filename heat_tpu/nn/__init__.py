@@ -1,0 +1,7 @@
+"""Neural networks (reference heat/nn/). The reference's ``__getattr__`` falls through
+to ``torch.nn`` (``nn/__init__.py:18-31``); torch layers cannot execute on TPU, so the
+native module system in :mod:`.modules` is the fallthrough surface here."""
+
+from .data_parallel import *
+from .modules import *
+from . import data_parallel, modules

@@ -1,0 +1,196 @@
+"""Neural-network modules (reference heat/nn/: falls through to ``torch.nn``,
+``nn/__init__.py:18-31``).
+
+The reference trains *torch* modules locally and glues them together with MPI gradient
+hooks. Torch modules cannot execute on TPU, so the TPU build ships a small native
+module system in the idiomatic JAX shape: a module is a *structure* whose parameters
+live in an explicit pytree, ``init(key)`` creates them, ``apply(params, x)`` is a pure
+function jittable end-to-end. A convenience stateful veneer (``__call__`` using the
+internally held params) preserves the torch-like feel of the reference examples.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dndarray import DNDarray
+
+__all__ = [
+    "Module",
+    "Linear",
+    "ReLU",
+    "Tanh",
+    "Sigmoid",
+    "LogSoftmax",
+    "Flatten",
+    "Dropout",
+    "Sequential",
+    "MSELoss",
+    "NLLLoss",
+    "CrossEntropyLoss",
+]
+
+
+def _to_value(x):
+    return x.larray if isinstance(x, DNDarray) else x
+
+
+class Module:
+    """Base module: explicit-parameter pytrees + pure ``apply``."""
+
+    def init(self, key: jax.Array) -> Any:
+        """Create this module's parameter pytree."""
+        return ()
+
+    def apply(self, params: Any, x: jax.Array, *, key: Optional[jax.Array] = None, train: bool = False) -> jax.Array:
+        """Pure forward pass."""
+        raise NotImplementedError()
+
+    # ------------------------------------------------------------- stateful veneer
+    @property
+    def params(self):
+        if not hasattr(self, "_params"):
+            self._params = self.init(jax.random.key(0))
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        self._params = value
+
+    def reset_parameters(self, seed: int = 0) -> None:
+        """Re-create parameters from a seed — every process derives identical values,
+        the property the reference enforces by seed-broadcast + param Bcast
+        (``nn/data_parallel.py:105-106``)."""
+        self._params = self.init(jax.random.key(seed))
+
+    def __call__(self, x, *, key=None, train: bool = False):
+        value = self.apply(self.params, _to_value(x), key=key, train=train)
+        if isinstance(x, DNDarray):
+            from ..core._operations import wrap_result
+
+            return wrap_result(value, x, x.split if x.split == 0 else None)
+        return value
+
+
+class Linear(Module):
+    """Affine layer y = x W + b (torch.nn.Linear semantics, He-uniform init)."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.bias = bias
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        bound = 1.0 / np.sqrt(self.in_features)
+        # float32 params regardless of the global x64 flag — the TPU-native precision
+        w = jax.random.uniform(
+            k1, (self.in_features, self.out_features), jnp.float32, -bound, bound
+        )
+        if not self.bias:
+            return {"weight": w}
+        b = jax.random.uniform(k2, (self.out_features,), jnp.float32, -bound, bound)
+        return {"weight": w, "bias": b}
+
+    def apply(self, params, x, *, key=None, train=False):
+        y = x @ params["weight"]
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class ReLU(Module):
+    def apply(self, params, x, *, key=None, train=False):
+        return jnp.maximum(x, 0.0)
+
+
+class Tanh(Module):
+    def apply(self, params, x, *, key=None, train=False):
+        return jnp.tanh(x)
+
+
+class Sigmoid(Module):
+    def apply(self, params, x, *, key=None, train=False):
+        return jax.nn.sigmoid(x)
+
+
+class LogSoftmax(Module):
+    def __init__(self, dim: int = -1):
+        self.dim = dim
+
+    def apply(self, params, x, *, key=None, train=False):
+        return jax.nn.log_softmax(x, axis=self.dim)
+
+
+class Flatten(Module):
+    def apply(self, params, x, *, key=None, train=False):
+        return x.reshape(x.shape[0], -1)
+
+
+class Dropout(Module):
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def apply(self, params, x, *, key=None, train=False):
+        if not train or self.p == 0.0:
+            return x
+        if key is None:
+            raise ValueError("Dropout in train mode needs an explicit PRNG key")
+        keep = jax.random.bernoulli(key, 1.0 - self.p, x.shape)
+        return jnp.where(keep, x / (1.0 - self.p), 0.0)
+
+
+class Sequential(Module):
+    """Chained modules (torch.nn.Sequential semantics)."""
+
+    def __init__(self, *layers: Module):
+        self.layers = list(layers)
+
+    def init(self, key):
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        return [layer.init(k) for layer, k in zip(self.layers, keys)]
+
+    def apply(self, params, x, *, key=None, train=False):
+        keys = (
+            jax.random.split(key, max(len(self.layers), 1))
+            if key is not None
+            else [None] * len(self.layers)
+        )
+        for layer, p, k in zip(self.layers, params, keys):
+            x = layer.apply(p, x, key=k, train=train)
+        return x
+
+
+# ------------------------------------------------------------------------- losses
+class MSELoss:
+    """Mean squared error. The global mean over a batch sharded on the mesh makes the
+    gradient all-reduce implicit — this IS the reference's blocking Allreduce hook
+    (``nn/data_parallel.py:220-238``), emitted by XLA instead of written by hand."""
+
+    def __call__(self, pred, target):
+        p, t = _to_value(pred), _to_value(target)
+        return jnp.mean((p - t) ** 2)
+
+
+class NLLLoss:
+    """Negative log likelihood over log-probabilities (torch.nn.NLLLoss semantics)."""
+
+    def __call__(self, log_probs, target):
+        lp, t = _to_value(log_probs), _to_value(target)
+        picked = jnp.take_along_axis(lp, t[:, None].astype(jnp.int64), axis=1)
+        return -jnp.mean(picked)
+
+
+class CrossEntropyLoss:
+    """Softmax cross-entropy on raw logits (torch.nn.CrossEntropyLoss semantics)."""
+
+    def __call__(self, logits, target):
+        lg, t = _to_value(logits), _to_value(target)
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(lp, t[:, None].astype(jnp.int64), axis=1)
+        return -jnp.mean(picked)

@@ -1,0 +1,17 @@
+"""Optimizers (reference heat/optim/: DataParallelOptimizer, DASO, torch passthrough
+``optim/__init__.py:19-36``). The passthrough target here is optax — ``ht.optim.sgd``
+etc. resolve to optax factories."""
+
+from .dp_optimizer import *
+from . import dp_optimizer, lr_scheduler
+
+
+def __getattr__(name):
+    """Fall through to optax (the reference falls through to torch.optim,
+    ``optim/__init__.py:19-36``)."""
+    try:
+        import optax
+
+        return getattr(optax, name)
+    except (ImportError, AttributeError):
+        raise AttributeError(f"module 'heat_tpu.optim' has no attribute {name!r}")

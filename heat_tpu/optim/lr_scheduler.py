@@ -1,0 +1,27 @@
+"""Learning-rate schedulers (reference heat/optim/lr_scheduler.py, 16 LoC: a passthrough
+to ``torch.optim.lr_scheduler``). The TPU equivalents are optax schedules; the common
+ones are re-exported here under their torch names."""
+
+from __future__ import annotations
+
+__all__ = ["StepLR", "ExponentialLR", "CosineAnnealingLR"]
+
+try:
+    import optax
+
+    def StepLR(step_size: int, gamma: float = 0.1, base_lr: float = 0.01):
+        """Decay the lr by gamma every step_size steps (torch.optim.lr_scheduler.StepLR)."""
+        return optax.exponential_decay(
+            init_value=base_lr, transition_steps=step_size, decay_rate=gamma, staircase=True
+        )
+
+    def ExponentialLR(gamma: float, base_lr: float = 0.01):
+        """Multiply the lr by gamma every step."""
+        return optax.exponential_decay(init_value=base_lr, transition_steps=1, decay_rate=gamma)
+
+    def CosineAnnealingLR(T_max: int, eta_min: float = 0.0, base_lr: float = 0.01):
+        """Cosine annealing from base_lr to eta_min over T_max steps."""
+        return optax.cosine_decay_schedule(init_value=base_lr, decay_steps=T_max, alpha=eta_min / max(base_lr, 1e-12))
+
+except ImportError:  # pragma: no cover
+    pass

@@ -1,3 +1,4 @@
 """Data utilities (reference heat/utils/data/)."""
 
-from . import matrixgallery, spherical
+from .datatools import *
+from . import datatools, matrixgallery, mnist, partial_dataset, spherical

@@ -1,0 +1,124 @@
+"""Data loading tools (reference heat/utils/data/datatools.py, 376 LoC).
+
+The reference wraps ``torch.utils.data.DataLoader`` over each rank's local chunk and
+re-shuffles samples *across* ranks between epochs with an Alltoall of sample blocks
+(``dataset_shuffle`` ``datatools.py:246``). With one global sharded array both collapse:
+a ``DataLoader`` here iterates jit-sized minibatch views of the global value, and the
+inter-epoch shuffle is a single global permutation whose all-to-all XLA emits.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from ...core.dndarray import DNDarray
+
+__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
+
+
+class Dataset:
+    """Dataset over one or more aligned DNDarrays (reference ``datatools.py:143``: wraps
+    the process-local chunk; here the global arrays themselves)."""
+
+    def __init__(self, array: DNDarray, *arrays: DNDarray, ishuffle: bool = False, test_set: bool = False):
+        self.arrays: Tuple[DNDarray, ...] = (array,) + arrays
+        n = self.arrays[0].gshape[0]
+        for a in self.arrays[1:]:
+            if a.gshape[0] != n:
+                raise ValueError("all arrays must share the leading (sample) dimension")
+        self.ishuffle = ishuffle
+        self.test_set = test_set
+
+    def __len__(self) -> int:
+        return self.arrays[0].gshape[0]
+
+    def __getitem__(self, index):
+        items = tuple(a[index] for a in self.arrays)
+        return items[0] if len(items) == 1 else items
+
+    def shuffle(self) -> None:
+        """Uniform global permutation of the samples (reference ``dataset_shuffle``)."""
+        dataset_shuffle(self)
+
+
+class DataLoader:
+    """Minibatch iterator over a Dataset or DNDarray (reference ``datatools.py:16``).
+
+    Yields batches as DNDarrays (split preserved). ``drop_last`` defaults to True so
+    every batch has identical shape — one compiled program per step, no re-tracing.
+    """
+
+    def __init__(
+        self,
+        dataset=None,
+        batch_size: int = 1,
+        num_workers: int = 0,
+        collate_fn=None,
+        pin_memory: bool = False,
+        drop_last: bool = True,
+        timeout: float = 0,
+        worker_init_fn=None,
+        lcl_dataset=None,
+        use_ishuffle: bool = False,
+    ):
+        dataset = dataset if dataset is not None else lcl_dataset
+        if isinstance(dataset, DNDarray):
+            dataset = Dataset(dataset)
+        elif hasattr(dataset, "htdata"):
+            # MNISTDataset-style wrappers (reference utils/data/mnist.py)
+            arrays = (dataset.htdata,) + (
+                (dataset.httargets,) if hasattr(dataset, "httargets") else ()
+            )
+            dataset = Dataset(*arrays, test_set=getattr(dataset, "test_set", False))
+        if not isinstance(dataset, Dataset):
+            raise TypeError(f"dataset must be a Dataset or DNDarray, got {type(dataset)}")
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self.use_ishuffle = use_ishuffle
+        self._first_epoch = True
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator:
+        # re-shuffle between epochs (reference shuffles at iterator creation after the
+        # first epoch, datatools.py:105-140)
+        if not self._first_epoch and not self.dataset.test_set:
+            self.dataset.shuffle()
+        self._first_epoch = False
+        n = len(self.dataset)
+        nbatches = len(self)
+        for b in range(nbatches):
+            lo = b * self.batch_size
+            hi = min(lo + self.batch_size, n)
+            yield self.dataset[lo:hi]
+
+
+def dataset_shuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Shuffle the dataset's samples across the whole mesh in place (reference
+    ``datatools.py:246``: an Alltoall of sample blocks — here one global take)."""
+    n = len(dataset)
+    perm = ht.random.randperm(n)
+    new_arrays = []
+    for a in dataset.arrays:
+        taken = jnp.take(a.larray, perm.larray, axis=0)
+        new_arrays.append(
+            DNDarray(
+                a.comm.shard(taken, a.split), a.gshape, a.dtype, a.split, a.device, a.comm, True
+            )
+        )
+    dataset.arrays = tuple(new_arrays)
+
+
+def dataset_ishuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Non-blocking shuffle (reference ``datatools.py:301``). XLA programs are
+    asynchronously dispatched already, so this is the same operation — kept for parity."""
+    dataset_shuffle(dataset, attrs)

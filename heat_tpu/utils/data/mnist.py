@@ -1,0 +1,44 @@
+"""MNIST dataset helper (reference heat/utils/data/mnist.py, 112 LoC: a torchvision
+MNIST subclass distributing samples across ranks). Gated on torchvision; the loaded
+images become one split-0 DNDarray."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import heat_tpu as ht
+
+__all__ = ["MNISTDataset"]
+
+
+class MNISTDataset:
+    """Distributed MNIST (reference ``mnist.py:16``): images as a split-0 DNDarray.
+
+    Requires torchvision with a local (pre-downloaded) MNIST copy; gate matches the
+    reference's optional torchvision dependency.
+    """
+
+    def __init__(self, root: str, train: bool = True, transform=None, ishuffle: bool = False, test_set: bool = False):
+        try:
+            from torchvision import datasets as tv_datasets
+        except ImportError as e:
+            raise RuntimeError("MNISTDataset requires torchvision") from e
+        base = tv_datasets.MNIST(root=root, train=train, download=False)
+        images = np.asarray(base.data, dtype=np.float32) / 255.0
+        labels = np.asarray(base.targets, dtype=np.int64)
+        self.htdata = ht.array(images, split=0)
+        self.httargets = ht.array(labels, split=0)
+        self.transform = transform
+        self.ishuffle = ishuffle
+        self.test_set = test_set
+
+    def __len__(self) -> int:
+        return self.htdata.gshape[0]
+
+    def __getitem__(self, index):
+        img = self.htdata[index]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.httargets[index]

@@ -1,0 +1,215 @@
+"""DL-layer tests (reference heat/nn/tests, heat/optim/tests, heat/utils/data/tests):
+modules, data-parallel training convergence, DASO phase machine, data tools."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+def _make_blobs(n_per=60, seed=0):
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal((-2, -2), 0.5, (n_per, 2))
+    x1 = rng.normal((2, 2), 0.5, (n_per, 2))
+    x = np.vstack([x0, x1]).astype(np.float32)
+    y = np.concatenate([np.zeros(n_per, np.int64), np.ones(n_per, np.int64)])
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+class TestModules(TestCase):
+    def test_linear_forward(self):
+        lin = ht.nn.Linear(4, 3)
+        lin.reset_parameters(seed=1)
+        x = ht.array(np.random.default_rng(0).random((6, 4)).astype(np.float32), split=0)
+        y = lin(x)
+        self.assertEqual(tuple(y.shape), (6, 3))
+        self.assertEqual(y.split, 0)
+        expected = x.numpy() @ np.asarray(lin.params["weight"]) + np.asarray(lin.params["bias"])
+        np.testing.assert_allclose(y.numpy(), expected, rtol=1e-5)
+
+    def test_sequential_and_activations(self):
+        model = ht.nn.Sequential(ht.nn.Linear(4, 8), ht.nn.ReLU(), ht.nn.Linear(8, 2), ht.nn.LogSoftmax())
+        model.reset_parameters(seed=0)
+        x = ht.array(np.random.default_rng(1).random((5, 4)).astype(np.float32))
+        out = model(x)
+        self.assertEqual(tuple(out.shape), (5, 2))
+        np.testing.assert_allclose(np.exp(out.numpy()).sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_identical_init_every_process(self):
+        a = ht.nn.Linear(3, 3)
+        b = ht.nn.Linear(3, 3)
+        a.reset_parameters(seed=0)
+        b.reset_parameters(seed=0)
+        np.testing.assert_array_equal(np.asarray(a.params["weight"]), np.asarray(b.params["weight"]))
+
+    def test_dropout(self):
+        import jax
+
+        d = ht.nn.Dropout(0.5)
+        x = np.ones((100, 10), np.float32)
+        out_eval = d.apply((), x)
+        np.testing.assert_array_equal(np.asarray(out_eval), x)
+        out_train = d.apply((), x, key=jax.random.key(0), train=True)
+        v = np.asarray(out_train)
+        self.assertTrue(((v == 0) | (v == 2.0)).all())
+        with self.assertRaises(ValueError):
+            d.apply((), x, train=True)
+
+    def test_losses(self):
+        logits = np.array([[2.0, -1.0], [-1.0, 3.0]], np.float32)
+        target = np.array([0, 1])
+        ce = ht.nn.CrossEntropyLoss()(ht.array(logits), ht.array(target))
+        expected = -np.mean(
+            np.log(np.exp(logits[np.arange(2), target]) / np.exp(logits).sum(1))
+        )
+        self.assertAlmostEqual(float(ce), float(expected), places=5)
+        mse = ht.nn.MSELoss()(ht.array(np.ones(4, np.float32)), ht.array(np.zeros(4, np.float32)))
+        self.assertAlmostEqual(float(mse), 1.0, places=6)
+
+
+class TestDataParallelTraining(TestCase):
+    def test_training_converges(self):
+        """North-star config #5: data-parallel MLP classification
+        (reference examples/nn/mnist.py shape, on separable blobs)."""
+        x_np, y_np = _make_blobs()
+        x = ht.array(x_np, split=0)
+        y = ht.array(y_np, split=0)
+
+        model = ht.nn.Sequential(ht.nn.Linear(2, 16), ht.nn.ReLU(), ht.nn.Linear(16, 2))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.5)
+        dp = ht.nn.DataParallel(model, optimizer=opt)
+        loss_fn_obj = ht.nn.CrossEntropyLoss()
+
+        def loss_fn(params, xb, yb):
+            return loss_fn_obj(model.apply(params, xb), yb)
+
+        losses = [opt.step(loss_fn, x, y) for _ in range(60)]
+        self.assertLess(losses[-1], 0.1)
+        self.assertLess(losses[-1], losses[0])
+        pred = np.argmax(dp(x).numpy(), axis=1)
+        self.assertGreater((pred == y_np).mean(), 0.95)
+
+    def test_dataloader_training(self):
+        x_np, y_np = _make_blobs(seed=3)
+        ds = ht.utils.data.Dataset(ht.array(x_np, split=0), ht.array(y_np, split=0))
+        loader = ht.utils.data.DataLoader(ds, batch_size=30)
+        model = ht.nn.Sequential(ht.nn.Linear(2, 8), ht.nn.ReLU(), ht.nn.Linear(8, 2))
+        opt = ht.optim.DataParallelOptimizer("adam", lr=0.05)
+        ht.nn.DataParallel(model, optimizer=opt)
+        lossf = ht.nn.CrossEntropyLoss()
+
+        def loss_fn(params, xb, yb):
+            return lossf(model.apply(params, xb), yb)
+
+        last = None
+        for epoch in range(8):
+            for xb, yb in loader:
+                last = opt.step(loss_fn, xb, yb)
+        self.assertLess(last, 0.2)
+        self.assertEqual(len(loader), len(ds) // 30)
+
+    def test_dp_errors(self):
+        with self.assertRaises(TypeError):
+            ht.nn.DataParallel(object())
+        opt = ht.optim.DataParallelOptimizer("sgd")
+        with self.assertRaises(RuntimeError):
+            opt.step(lambda p: 0.0)
+        with self.assertRaises(TypeError):
+            ht.optim.DataParallelOptimizer(blocking="yes")
+
+
+class TestDASO(TestCase):
+    def _setup(self, total_epochs=10, warmup=2, cooldown=2):
+        model = ht.nn.Sequential(ht.nn.Linear(2, 4), ht.nn.ReLU(), ht.nn.Linear(4, 2))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.1)
+        ht.nn.DataParallel(model, optimizer=opt)
+        daso = ht.optim.DASO(
+            local_optimizer=opt, total_epochs=total_epochs,
+            warmup_epochs=warmup, cooldown_epochs=cooldown, max_global_skips=8,
+        )
+        return model, opt, daso
+
+    def test_phase_machine(self):
+        model, opt, daso = self._setup()
+        self.assertEqual(daso._phase, "warmup")
+        for _ in range(2):
+            daso.epoch_end()
+        self.assertEqual(daso._phase, "cycling")
+        self.assertEqual(daso.global_skip, 8)
+        # plateaued loss halves the skips
+        for loss in (1.0, 1.0, 1.0):
+            daso.epoch_loss_logic(loss)
+        self.assertEqual(daso.global_skip, 4)
+        for _ in range(6):
+            daso.epoch_end()
+        self.assertEqual(daso._phase, "cooldown")
+        self.assertEqual(daso.global_skip, 0)
+
+    def test_daso_steps_train(self):
+        x_np, y_np = _make_blobs(seed=4)
+        model, opt, daso = self._setup(total_epochs=6, warmup=1, cooldown=1)
+        lossf = ht.nn.CrossEntropyLoss()
+
+        def loss_fn(params, xb, yb):
+            return lossf(model.apply(params, xb), yb)
+
+        x, y = ht.array(x_np, split=0), ht.array(y_np, split=0)
+        last = None
+        for epoch in range(6):
+            for _ in range(5):
+                last = daso.step(loss_fn, x, y)
+            daso.epoch_loss_logic(last)
+            daso.epoch_end()
+        daso.last_batch()
+        self.assertLess(last, 0.4)
+
+    def test_daso_validation(self):
+        opt = ht.optim.DataParallelOptimizer("sgd")
+        with self.assertRaises(ValueError):
+            ht.optim.DASO(local_optimizer=opt, total_epochs=4, warmup_epochs=3, cooldown_epochs=3)
+        with self.assertRaises(TypeError):
+            ht.optim.DASO(local_optimizer=opt, total_epochs=-1)
+
+
+class TestDataTools(TestCase):
+    def test_dataset_shuffle(self):
+        x = ht.arange(40, split=0).reshape((20, 2))
+        y = ht.arange(20, split=0)
+        ds = ht.utils.data.Dataset(x, y)
+        ht.random.seed(5)
+        ds.shuffle()
+        xs, ys = ds.arrays
+        # alignment preserved: row i of x still pairs with label i
+        np.testing.assert_array_equal(xs.numpy()[:, 0] // 2, ys.numpy())
+        self.assertFalse(np.array_equal(ys.numpy(), np.arange(20)))
+        np.testing.assert_array_equal(np.sort(ys.numpy()), np.arange(20))
+
+    def test_dataloader_batches(self):
+        x = ht.arange(24, split=0).reshape((12, 2))
+        loader = ht.utils.data.DataLoader(x, batch_size=5)
+        batches = list(loader)
+        self.assertEqual(len(batches), 2)  # drop_last
+        self.assertEqual(tuple(batches[0].shape), (5, 2))
+        with self.assertRaises(TypeError):
+            ht.utils.data.DataLoader(42)
+
+    def test_partial_h5(self):
+        if not ht.io.supports_hdf5():
+            self.skipTest("h5py not available")
+        import os
+        import tempfile
+
+        p = os.path.join(tempfile.mkdtemp(), "stream.h5")
+        data = np.arange(100.0, dtype=np.float32).reshape(25, 4)
+        ht.save_hdf5(ht.array(data), p, "data")
+        ds = ht.utils.data.partial_dataset.PartialH5Dataset(p, load_length=10)
+        chunks = [np.asarray(c) for c in ds]
+        self.assertEqual(len(chunks), 3)
+        np.testing.assert_allclose(np.vstack(chunks), data)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
