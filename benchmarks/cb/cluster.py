@@ -1,0 +1,45 @@
+"""Cluster benchmarks (reference benchmarks/cb/cluster.py:24-32: kmeans/kmedians/
+kmedoids on the spherical dataset n=5000·4)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import heat_tpu as ht
+from benchmarks.cb.monitor import monitor
+from heat_tpu.utils.data.spherical import create_spherical_dataset
+
+N = int(os.environ.get("HEAT_TPU_BENCH_CLUSTER_N", "5000"))
+
+
+def _data():
+    return create_spherical_dataset(num_samples_cluster=N, radius=1.0, offset=4.0, random_state=1)
+
+
+@monitor("kmeans")
+def kmeans():
+    km = ht.cluster.KMeans(n_clusters=4, init="kmeans++", max_iter=30, random_state=1)
+    km.fit(_data())
+    return km.cluster_centers_.larray
+
+
+@monitor("kmedians")
+def kmedians():
+    km = ht.cluster.KMedians(n_clusters=4, init="kmedians++", max_iter=30, random_state=1)
+    km.fit(_data())
+    return km.cluster_centers_.larray
+
+
+@monitor("kmedoids")
+def kmedoids():
+    km = ht.cluster.KMedoids(n_clusters=4, init="kmedoids++", random_state=1)
+    km.fit(_data())
+    return km.cluster_centers_.larray
+
+
+@monitor("batchparallel_kmeans")
+def batchparallel_kmeans():
+    km = ht.cluster.BatchParallelKMeans(n_clusters=4, init="k-means++", max_iter=30, random_state=1)
+    km.fit(_data())
+    return km.cluster_centers_.larray

@@ -1,0 +1,64 @@
+"""Linalg benchmarks (reference benchmarks/cb/linalg.py:44-74: matmul split0/1 n=3000,
+qr split0/1 n=2000, lanczos n=50 f64, hsvd_rank/rtol 1000x500·P rank 10)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import heat_tpu as ht
+from benchmarks.cb.monitor import monitor
+
+N_MM = int(os.environ.get("HEAT_TPU_BENCH_N", "3000"))
+
+
+@monitor("matmul_split0")
+def matmul_split_0():
+    a = ht.random.random((N_MM, N_MM), split=0)
+    b = ht.random.random((N_MM, N_MM), split=0)
+    return ht.matmul(a, b).larray
+
+
+@monitor("matmul_split1")
+def matmul_split_1():
+    a = ht.random.random((N_MM, N_MM), split=1)
+    b = ht.random.random((N_MM, N_MM), split=1)
+    return ht.matmul(a, b).larray
+
+
+@monitor("qr_split0")
+def qr_split_0():
+    n = N_MM * 2 // 3
+    a = ht.random.random((n, n // 4), split=0)
+    q, r = ht.linalg.qr(a)
+    return q.larray
+
+
+@monitor("qr_split1")
+def qr_split_1():
+    n = N_MM * 2 // 3
+    a = ht.random.random((n // 4, n), split=1)
+    q, r = ht.linalg.qr(a)
+    return q.larray
+
+
+@monitor("lanczos")
+def lanczos():
+    a = ht.random.random((50, 50), dtype=ht.float64, split=0)
+    spd = ht.matmul(a, a.T.resplit(0)) + 50.0 * ht.eye(50, split=0, dtype=ht.float64)
+    v, t = ht.linalg.lanczos(spd, 30)
+    return v.larray
+
+
+@monitor("hsvd_rank")
+def hsvd_rank():
+    a = ht.random.random((1000, 500 * max(ht.get_comm().size, 1)), split=1)
+    u, err = ht.linalg.hsvd_rank(a, 10)
+    return u.larray
+
+
+@monitor("hsvd_rtol")
+def hsvd_rtol():
+    a = ht.random.random((1000, 500 * max(ht.get_comm().size, 1)), split=1)
+    u, err = ht.linalg.hsvd_rtol(a, 1e-2)
+    return u.larray

@@ -1,0 +1,23 @@
+"""Continuous-benchmark suite entry (reference benchmarks/cb/main.py:14-17).
+
+The reference instruments each benchmark with the perun energy/runtime monitor
+(``@monitor()`` decorators) and publishes to a dashboard. Here :func:`monitor` wraps
+each benchmark with wall-clock timing around a forced device sync and emits one JSON
+line per benchmark — the same contract, TPU-native measurement.
+
+Run: ``python benchmarks/cb/main.py`` (optionally HEAT_TPU_BENCH_FILTER=substring).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from benchmarks.cb.monitor import run_all  # noqa: E402
+
+import benchmarks.cb.linalg  # noqa: F401,E402
+import benchmarks.cb.cluster  # noqa: F401,E402
+import benchmarks.cb.manipulations  # noqa: F401,E402
+
+if __name__ == "__main__":
+    run_all(filter_substring=os.environ.get("HEAT_TPU_BENCH_FILTER"))

@@ -1,0 +1,34 @@
+"""Manipulation benchmarks (reference benchmarks/cb/manipulations.py:18-32: reshape
+1000x{large} → split1, concatenate 3×(1000, n))."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import heat_tpu as ht
+from benchmarks.cb.monitor import monitor
+
+N = int(os.environ.get("HEAT_TPU_BENCH_MANIP_N", "1000000"))
+
+
+@monitor("reshape_new_split")
+def reshape():
+    m = N // 1000
+    a = ht.random.random((1000, m), split=0)
+    return ht.reshape(a, (250, 4 * m), new_split=1).larray
+
+
+@monitor("concatenate")
+def concatenate():
+    n = N // 1000
+    a = ht.random.random((1000, n), split=1)
+    b = ht.random.random((1000, n), split=None)
+    c = ht.random.random((1000, n), split=1)
+    return ht.concatenate([a, b.resplit(1), c], axis=1).larray
+
+
+@monitor("resplit")
+def resplit_bench():
+    a = ht.random.random((1000, N // 1000), split=0)
+    return a.resplit(1).larray

@@ -1,0 +1,35 @@
+"""Benchmark monitor (the perun replacement; reference decorates with ``@monitor()``
+from the perun package, benchmarks/cb/linalg.py:5-40)."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, List, Optional, Tuple
+
+_REGISTRY: List[Tuple[str, Callable]] = []
+
+
+def monitor(name: Optional[str] = None):
+    """Register a benchmark; measurement is wall-clock around a device sync."""
+
+    def decorate(fn: Callable) -> Callable:
+        _REGISTRY.append((name or fn.__name__, fn))
+        return fn
+
+    return decorate
+
+
+def run_all(filter_substring: Optional[str] = None) -> None:
+    import jax
+
+    for name, fn in _REGISTRY:
+        if filter_substring and filter_substring not in name:
+            continue
+        # warmup run compiles; timed run measures steady state
+        fn()
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out) if out is not None else None
+        elapsed = time.perf_counter() - t0
+        print(json.dumps({"benchmark": name, "wall_s": round(elapsed, 4), "backend": jax.default_backend(), "devices": len(jax.devices())}))

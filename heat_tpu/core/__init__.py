@@ -1,6 +1,7 @@
 """heat_tpu core: distributed n-D arrays over JAX/XLA (reference heat/core/__init__.py)."""
 
 from .communication import *
+from .constants import *
 from .devices import *
 from .types import *
 from .stride_tricks import *
@@ -34,6 +35,7 @@ from . import (
     base,
     communication,
     complex_math,
+    constants,
     devices,
     dndarray,
     exponential,

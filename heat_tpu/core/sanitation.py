@@ -13,6 +13,7 @@ from .communication import MeshCommunication
 from .dndarray import DNDarray
 
 __all__ = [
+    "sanitize_sequence",
     "sanitize_in",
     "sanitize_infinity",
     "sanitize_in_tensor",
@@ -101,3 +102,17 @@ def scalar_to_1d(x: DNDarray) -> DNDarray:
         x.comm,
         True,
     )
+
+
+def sanitize_sequence(seq):
+    """Check that ``seq`` is a valid sequence and return it as a list
+    (reference ``sanitation.py:314``)."""
+    if isinstance(seq, list):
+        return seq
+    if isinstance(seq, tuple):
+        return list(seq)
+    from .dndarray import DNDarray
+
+    if isinstance(seq, DNDarray):
+        return seq.tolist()
+    raise TypeError(f"seq must be a list, tuple or DNDarray, got {type(seq)}")

@@ -23,6 +23,8 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = [
+    "iscomplex",
+    "isreal",
     "datatype",
     "number",
     "integer",
@@ -496,3 +498,24 @@ class iinfo:
 
     def __repr__(self):
         return f"iinfo(dtype={self.dtype}, max={self.max}, min={self.min})"
+
+
+def iscomplex(x):
+    """Test element-wise if input is complex (reference ``types.py:766``)."""
+    from . import _operations
+    import jax.numpy as jnp
+
+    return _operations.local_op(
+        lambda v: jnp.iscomplexobj(v) & (jnp.imag(v) != 0) if jnp.iscomplexobj(v) else jnp.zeros(v.shape, jnp.bool_),
+        x,
+    )
+
+
+def isreal(x):
+    """Test element-wise if input is real-valued (reference ``types.py:788``)."""
+    from . import _operations
+    import jax.numpy as jnp
+
+    return _operations.local_op(
+        lambda v: jnp.isreal(v), x,
+    )
