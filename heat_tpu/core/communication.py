@@ -67,12 +67,55 @@ class MeshCommunication(Communication):
     multi-controller deployment ``process_rank`` additionally reports the host process.
     """
 
-    def __init__(self, devices: Optional[Sequence[jax.Device]] = None, axis_name: str = MESH_AXIS):
+    def __init__(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        axis_name: str = MESH_AXIS,
+        mesh_shape: Optional[Sequence[int]] = None,
+        axis_names: Optional[Sequence[str]] = None,
+    ):
         if devices is None:
             devices = jax.devices()
         self._devices: List[jax.Device] = list(devices)
-        self.axis_name = axis_name
-        self.mesh = Mesh(np.array(self._devices), (axis_name,))
+        if mesh_shape is None:
+            self.axis_names: Tuple[str, ...] = (axis_name,)
+            self.mesh = Mesh(np.array(self._devices), self.axis_names)
+            self.axis_name = axis_name
+        else:
+            # N-D mesh (reference DASO's node-local × global hierarchy maps to the
+            # ici × dcn axes of a 2-D device mesh, SURVEY §2.4). A ``split`` dimension
+            # is sharded over ALL axes jointly; per-axis collectives go through the
+            # ``axis_name=`` argument of the collective helpers.
+            self.axis_names = tuple(axis_names or ("dcn", "ici"))
+            if len(self.axis_names) != len(tuple(mesh_shape)):
+                raise ValueError(
+                    f"axis_names {self.axis_names} does not match mesh_shape {mesh_shape}"
+                )
+            self.mesh = Mesh(np.array(self._devices).reshape(tuple(mesh_shape)), self.axis_names)
+            # collectives over a multi-axis comm default to reducing over all axes
+            self.axis_name = self.axis_names if len(self.axis_names) > 1 else self.axis_names[0]
+
+    @classmethod
+    def hierarchical(
+        cls,
+        n_nodes: int,
+        devices: Optional[Sequence[jax.Device]] = None,
+        axis_names: Sequence[str] = ("dcn", "ici"),
+    ) -> "MeshCommunication":
+        """A 2-D (slow × fast) communicator: ``n_nodes`` groups over the slow ``dcn``
+        axis, remaining devices per group on the fast ``ici`` axis.
+
+        This is the TPU shape of the reference DASO's hierarchy — torch-DDP inside a
+        node, skipped MPI syncs across nodes (reference ``optim/dp_optimizer.py:64-155``).
+        """
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        if n_nodes <= 0 or len(devices) % n_nodes != 0:
+            raise ValueError(
+                f"cannot split {len(devices)} devices into {n_nodes} equal node groups"
+            )
+        return cls(devices, mesh_shape=(n_nodes, len(devices) // n_nodes), axis_names=axis_names)
 
     # ------------------------------------------------------------------ topology
     @property
@@ -96,6 +139,20 @@ class MeshCommunication(Communication):
     @property
     def devices(self) -> List[jax.Device]:
         return self._devices
+
+    @property
+    def is_hierarchical(self) -> bool:
+        return len(self.axis_names) > 1
+
+    @property
+    def n_nodes(self) -> int:
+        """Size of the slow (first) mesh axis — 1 on a flat mesh."""
+        return int(self.mesh.shape[self.axis_names[0]]) if self.is_hierarchical else 1
+
+    @property
+    def node_size(self) -> int:
+        """Devices per node group — the fast-axis extent."""
+        return self.size // self.n_nodes
 
     @staticmethod
     def is_distributed() -> bool:
@@ -155,11 +212,14 @@ class MeshCommunication(Communication):
 
     # ------------------------------------------------------------------ sharding
     def spec(self, ndim: int, split: Optional[int]) -> PartitionSpec:
-        """The ``PartitionSpec`` encoding Heat's ``split`` for an ``ndim``-d array."""
+        """The ``PartitionSpec`` encoding Heat's ``split`` for an ``ndim``-d array.
+
+        On a multi-axis mesh the split dimension is sharded over all axes jointly
+        (major-to-minor), so ``size`` shards exist either way."""
         if split is None:
             return PartitionSpec()
         entries = [None] * ndim
-        entries[split] = self.axis_name
+        entries[split] = self.axis_names if len(self.axis_names) > 1 else self.axis_names[0]
         return PartitionSpec(*entries)
 
     def sharding(self, ndim: int, split: Optional[int]) -> NamedSharding:
@@ -252,14 +312,21 @@ class MeshCommunication(Communication):
         per shard index; the sub-communicator returned is the group containing shard
         ``self.rank``. A scalar colour means every shard shares it (≙ MPI dup).
         """
+        axis = self.axis_names[-1] if self.is_hierarchical else self.axis_name
         if np.isscalar(color):
-            return MeshCommunication(self._devices, axis_name=self.axis_name)
+            if self.is_hierarchical:  # true dup: keep the mesh topology
+                return MeshCommunication(
+                    self._devices,
+                    mesh_shape=self.mesh.devices.shape,
+                    axis_names=self.axis_names,
+                )
+            return MeshCommunication(self._devices, axis_name=axis)
         colors = list(color)
         if len(colors) != self.size:
             raise ValueError(f"need one color per shard ({self.size}), got {len(colors)}")
         mine = colors[self.rank]
         devs = [d for i, d in enumerate(self._devices) if colors[i] == mine]
-        return MeshCommunication(devs, axis_name=self.axis_name)
+        return MeshCommunication(devs, axis_name=axis)
 
 
 # A jitted, cached reshard for ragged (non-divisible) dims: GSPMD pads internally.

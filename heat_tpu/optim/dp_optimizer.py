@@ -118,9 +118,21 @@ class DASO:
     Keeps the reference's three-phase schedule — warmup (global sync every step),
     cycling (sync every ``global_skips`` batches, halving the skips when the loss
     plateaus), cooldown (every step again) — driving when parameters are averaged over
-    the slow mesh axis. On a 1-D mesh the average is the identity (XLA already syncs);
-    on a 2-D (ici × dcn) mesh it lowers to DCN collectives at exactly the cadence the
-    phase machine dictates.
+    the slow mesh axis.
+
+    Mechanism (the TPU shape of reference ``_global_sync :450`` + ``_gs_send_params
+    :610``): the communicator carries a 2-D ``(dcn, ici)`` mesh
+    (:meth:`MeshCommunication.hierarchical`). Parameters are held as ``n_nodes``
+    replicas stacked on a leading axis sharded over ``dcn`` — each node group trains
+    its own replica on its own slice of the batch (gradients reduce over ``ici``
+    only), so replicas *diverge* between global syncs exactly as the reference's
+    node-local DDP copies do. ``_global_sync`` sends per-replica *deltas* downcast to
+    ``downcast_type`` over the wire (reference bf16 custom MPI ops ``:21-63``),
+    averages across ``dcn`` (the XLA all-reduce rides the slow axis), and broadcasts
+    the result back into every replica; deltas keep full relative precision in bf16,
+    so the f32 master never loses sub-ulp updates. ``sending_chunk_size`` is accepted
+    for API parity — XLA
+    segments collective payloads itself, so it has no effect here.
     """
 
     def __init__(
@@ -170,6 +182,14 @@ class DASO:
         if warmup_epochs == 0:
             self._start_cycling()
 
+        # per-node parameter replicas: leaves of shape (n_nodes, *param.shape),
+        # sharded over the slow mesh axis; materialised lazily at the first step
+        self._stacked_params = None
+        self._stacked_opt_state = None
+        self._step_fns: dict = {}
+        self._sync_fn = None
+        self._model_params_stale = False
+
     # ------------------------------------------------------------------ phase machine
     def _start_cycling(self) -> None:
         self._phase = "cycling"
@@ -205,10 +225,71 @@ class DASO:
             self.local_skip = 0
         elif self.epoch >= self.warmup_epochs and self._phase == "warmup":
             self._start_cycling()
+        self.sync_model_params()
 
     def last_batch(self) -> None:
         """Force a final full sync (reference ``:735``)."""
         self.global_skip = 0
+
+    def sync_model_params(self) -> None:
+        """Refresh the user-visible ``model.params`` from replica 0.
+
+        Kept out of the per-step sync path: slicing the dcn-sharded stack is a
+        cross-slow-axis gather, so it happens lazily (epoch boundaries, or on demand)
+        rather than every training step."""
+        model = self.local_optimizer._model
+        if model is not None and self._stacked_params is not None and self._model_params_stale:
+            model.params = jax.tree.map(lambda s: s[0], self._stacked_params)
+            self._model_params_stale = False
+
+    # ------------------------------------------------------------------ replicas
+    @property
+    def n_nodes(self) -> int:
+        return getattr(self.comm, "n_nodes", 1)
+
+    def _node_spec(self, extra_dims: int):
+        """PartitionSpec for a replica-stacked leaf: leading dim over the slow axis."""
+        from jax.sharding import PartitionSpec
+
+        axis = self.comm.axis_names[0] if getattr(self.comm, "is_hierarchical", False) else None
+        return PartitionSpec(axis, *([None] * extra_dims))
+
+    def _stack_sharding(self, leaf_ndim: int):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.comm.mesh, self._node_spec(leaf_ndim))
+
+    def _materialize(self) -> None:
+        """Replicate the model's parameters into n_nodes stacked copies, sharded over
+        the slow axis, and vmap-init the per-replica optimizer states."""
+        model = self.local_optimizer._model
+        if model is None:
+            raise RuntimeError("DASO's local optimizer is not attached to a model")
+        n = self.n_nodes
+
+        def stack(p):
+            s = jnp.broadcast_to(p[None], (n,) + p.shape)
+            return jax.device_put(s, self._stack_sharding(p.ndim))
+
+        self._stacked_params = jax.tree.map(stack, model.params)
+        self._stacked_opt_state = jax.vmap(self.local_optimizer.local_optimizer.init)(
+            self._stacked_params
+        )
+
+    @property
+    def stacked_params(self):
+        """The (n_nodes, ...) per-node parameter replicas (None before the first step)."""
+        return self._stacked_params
+
+    @stacked_params.setter
+    def stacked_params(self, value):
+        self._stacked_params = value
+
+    def consolidated_params(self):
+        """One synced copy of the parameters: the mean over node replicas."""
+        if self._stacked_params is None:
+            return self.local_optimizer._model.params
+        return jax.tree.map(lambda s: jnp.mean(s, axis=0), self._stacked_params)
 
     # ------------------------------------------------------------------ stepping
     def _should_global_sync(self) -> bool:
@@ -217,31 +298,97 @@ class DASO:
         return self._batch_in_epoch % self.global_skip == 0
 
     def step(self, loss_fn: Optional[Callable] = None, *batch) -> float:
-        """Local optimizer step + cadence-gated global parameter averaging
+        """Node-local optimizer step on each replica + cadence-gated global averaging
         (reference step state machine ``:747-832``)."""
-        loss = self.local_optimizer.step(loss_fn, *batch)
+        if loss_fn is None:
+            raise TypeError("step() requires loss_fn(params, *batch)")
+        if self._stacked_params is None:
+            self._materialize()
+        values = tuple(_to_value(b) for b in batch)
+        step_fn = self._step_fns.get(loss_fn)
+        if step_fn is None:
+            step_fn = self._step_fns[loss_fn] = self._build_step(loss_fn)
+        self._stacked_params, self._stacked_opt_state, loss = step_fn(
+            self._stacked_params, self._stacked_opt_state, *values
+        )
         if self._should_global_sync():
             self._global_sync()
         self._batch_in_epoch += 1
+        if jax.default_backend() == "cpu":
+            loss.block_until_ready()
         return loss
 
-    def _global_sync(self) -> None:
-        """Average parameters across the slow mesh axis (reference ``_global_sync``
-        ``:450`` with bf16-downcast chunked sends ``:610``).
+    def _build_step(self, loss_fn):
+        """One XLA program: split the global batch into node sub-batches (sharded
+        dcn × ici), vmap the per-replica value_and_grad + update over the node axis.
+        Each replica sees only its node's data — the divergence between syncs is the
+        reference's node-local DDP behavior."""
+        from jax.sharding import NamedSharding, PartitionSpec
 
-        Single-controller arrays are already globally consistent — the re-shard below
-        is the hook point where a 2-D (ici, dcn) mesh emits the DCN all-reduce; the
-        downcast mirrors the reference's bandwidth optimisation.
-        """
-        model = self.local_optimizer._model
-        if model is None:
+        n = self.n_nodes
+        opt = self.local_optimizer.local_optimizer
+        comm = self.comm
+        hier = getattr(comm, "is_hierarchical", False)
+        dcn = comm.axis_names[0] if hier else None
+        fast = comm.axis_names[1] if hier else comm.axis_names[0]
+
+        @jax.jit
+        def _step(stacked, opt_states, *vals):
+            def split_batch(v):
+                if v.shape[0] % n:
+                    raise ValueError(
+                        f"batch size {v.shape[0]} not divisible by n_nodes={n}"
+                    )
+                v = v.reshape((n, v.shape[0] // n) + v.shape[1:])
+                spec = PartitionSpec(dcn, fast, *([None] * (v.ndim - 2)))
+                return jax.lax.with_sharding_constraint(
+                    v, NamedSharding(comm.mesh, spec)
+                )
+
+            vs = tuple(split_batch(v) for v in vals)
+
+            def one(params, opt_state, *vb):
+                loss, grads = jax.value_and_grad(loss_fn)(params, *vb)
+                updates, opt_state = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss
+
+            new_p, new_o, losses = jax.vmap(one)(stacked, opt_states, *vs)
+            return new_p, new_o, losses.mean()
+
+        return _step
+
+    def _global_sync(self) -> None:
+        """Average the replicas across the slow mesh axis (reference ``_global_sync``
+        ``:450``): downcast to ``downcast_type`` for the wire (reference bf16 MPI ops
+        ``:21-63``), mean over the node axis — XLA lowers this to an all-reduce on the
+        dcn axis — and broadcast back into every replica at master precision."""
+        if self._stacked_params is None:
             return
-        # Single-controller global arrays are already consistent — the sync is a
-        # re-shard of the parameter pytree, which a 2-D (ici, dcn) mesh lowers to DCN
-        # all-reduces. ``downcast_type`` applies to that wire payload only; the f32
-        # master copy is never rounded (reference :610-660 keeps the master in f32
-        # too — rounding it would erase updates below the bf16 ulp).
-        model.params = jax.tree.map(lambda p: p, model.params)
+        if self._sync_fn is None:
+            wire = self.downcast_type
+
+            def avg(p):
+                if not jnp.issubdtype(p.dtype, jnp.floating):
+                    return p
+                # Wire payload = per-replica delta from replica 0, downcast for
+                # bandwidth. bf16 represents *small* deltas at full relative
+                # precision (it only truncates mantissa, not exponent), so sub-ulp
+                # parameter updates survive the sync — quantizing the parameters
+                # themselves would erase any update below ~0.4% of the weight.
+                ref = p[0:1]
+                delta = p - ref
+                if wire is not None:
+                    delta = delta.astype(wire)
+                m = ref[0] + jnp.mean(delta.astype(jnp.float32), axis=0).astype(p.dtype)
+                out = jnp.broadcast_to(m[None], p.shape)
+                return jax.lax.with_sharding_constraint(
+                    out, self._stack_sharding(p.ndim - 1)
+                )
+
+            self._sync_fn = jax.jit(lambda tree: jax.tree.map(avg, tree))
+        self._stacked_params = self._sync_fn(self._stacked_params)
+        self._model_params_stale = True
 
     def print0(self, *args, **kwargs) -> None:
         """Print from the first process only (reference ``:704``)."""
