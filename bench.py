@@ -1,21 +1,82 @@
 """Headline benchmark: prints ONE JSON line.
 
-North-star config #2 (BASELINE.md): distributed matmul, split-0 × split-1. The reference
-benches ``a @ b`` at n=3000 f32 under MPI (``benchmarks/cb/linalg.py:44-56``); the
-reference repo publishes no absolute numbers (BASELINE.json ``published: {}``), so
-``vs_baseline`` reports achieved fraction of the chip's peak matmul throughput —
-a hardware-normalised stand-in until a reference wall-clock exists.
+Covers three of the five north-star configs (BASELINE.md): distributed matmul
+split-0 × split-1 (reference ``benchmarks/cb/linalg.py:44-56``), KMeans fit
+(``benchmarks/cb/cluster.py:24-32``, scaled to the 10M×64 north-star), and
+``hsvd_rank`` (``benchmarks/cb/linalg.py:29-40``). The reference publishes no absolute
+numbers in-tree (BASELINE.json ``published: {}``), so ``vs_baseline`` of the headline
+matmul reports achieved fraction of the chip's peak bf16 matmul throughput; the other
+metrics ride along in ``extra_metrics`` as wall-clock seconds.
 
-Methodology: K chained matmuls inside ONE jitted program (the framework's compute path is
-XLA on mesh-sharded global arrays), timed around a final scalar readback —
-device-dispatch latency is excluded, as in the reference's perun wall-clock of a tight
-loop.
+All three time the *framework* path — ``ht.linalg.matmul`` / ``KMeans.fit`` /
+``ht.linalg.hsvd_rank`` on split DNDarrays — not raw jnp calls. Timing is
+best-of-3 around a scalar readback; the matmul chain keeps the device queue full so
+per-call dispatch latency (the ~70 ms tunnel round-trip) overlaps with compute.
 """
 
 import json
 import time
 
-import numpy as np
+
+def _bench_matmul(ht, jax, jnp, on_tpu):
+    n = 16384 if on_tpu else 512
+    iters = 16 if on_tpu else 4
+    dtype = ht.bfloat16 if on_tpu else ht.float32
+    scale = 1.0 / (n**0.5)  # keep chained products at unit variance
+
+    a = ht.array(jax.random.normal(jax.random.key(0), (n, n), dtype.jax_type()), split=0)
+    b = ht.array(
+        jax.random.normal(jax.random.key(1), (n, n), dtype.jax_type()) * scale, split=1
+    )
+
+    def chain():
+        c = a
+        for _ in range(iters):
+            c = ht.linalg.matmul(c, b)
+        return float(c.larray[0, 0])  # single-element readback syncs the queue
+
+    chain()  # compile + warmup
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        chain()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    ndev = len(jax.devices())
+    tflops = 2 * n**3 / best / 1e12 / ndev
+    return n, dtype.__name__, tflops
+
+
+def _bench_kmeans(ht, jax, jnp, on_tpu):
+    n, d, k = (10_000_000, 64, 8) if on_tpu else (50_000, 16, 4)
+    x = ht.array(
+        jax.random.normal(jax.random.key(2), (n, d), jnp.float32), split=0
+    )
+    km = ht.cluster.KMeans(n_clusters=k, init="random", max_iter=30, tol=-1.0,
+                           random_state=0)
+    km.fit(x)  # compile + warmup (tol<0 forces all 30 iterations)
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        km.fit(x)
+        best = min(best, time.perf_counter() - t0)
+    return n, d, k, best
+
+
+def _bench_hsvd(ht, jax, jnp, on_tpu):
+    m, n_per, blocks, rank = (2048, 4096, 8, 10) if on_tpu else (256, 256, 4, 5)
+    n = n_per * blocks
+    # rank-`rank` matrix, the reference's benchmark fixture shape
+    # (benchmarks/cb/linalg.py:29-40: 1000 x 500*nprocs, rank 10)
+    u = jax.random.normal(jax.random.key(3), (m, rank), jnp.float32)
+    v = jax.random.normal(jax.random.key(4), (rank, n), jnp.float32)
+    a = ht.array(u @ v, split=1)
+    ht.linalg.hsvd_rank(a, rank)  # compile + warmup
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        ht.linalg.hsvd_rank(a, rank)
+        best = min(best, time.perf_counter() - t0)
+    return m, n, rank, best
 
 
 def main():
@@ -25,41 +86,32 @@ def main():
     import heat_tpu as ht
 
     on_tpu = jax.default_backend() != "cpu"
-    n = 4096 if on_tpu else 1024
-    dtype = ht.bfloat16 if on_tpu else ht.float32
-    iters = 32
 
-    # distributed operands via the framework's factories (split-0 × split-1)
-    a = ht.array(jax.random.normal(jax.random.key(0), (n, n), dtype.jax_type()), split=0)
-    b = ht.array(jax.random.normal(jax.random.key(1), (n, n), dtype.jax_type()), split=1)
+    n, dtype_name, tflops = _bench_matmul(ht, jax, jnp, on_tpu)
+    kn, kd, kk, kmeans_s = _bench_kmeans(ht, jax, jnp, on_tpu)
+    hm, hn, hrank, hsvd_s = _bench_hsvd(ht, jax, jnp, on_tpu)
 
-    @jax.jit
-    def chained(a, b):
-        def body(i, c):
-            return (c @ b) * (1.0 / n)  # rescale to keep bf16 in range
-
-        return jax.lax.fori_loop(0, iters, body, a).sum()
-
-    # compile + warmup (first compile through the tunnel is slow)
-    float(chained(a.larray, b.larray))
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(chained(a.larray, b.larray))
-        best = min(best, (time.perf_counter() - t0) / iters)
-
-    flops = 2 * n**3
-    ndev = len(jax.devices())
-    tflops = flops / best / 1e12 / ndev
     # peak bf16 matmul throughput per chip: v5e ≈ 394 TFLOP/s (v5p ≈ 459); CPU: no target
     peak = 394.0 if on_tpu else max(tflops, 1e-9)
     print(
         json.dumps(
             {
-                "metric": f"matmul_{n}x{n}_{dtype.__name__}_split0x1_tflops_per_chip",
+                "metric": f"matmul_{n}x{n}_{dtype_name}_split0x1_tflops_per_chip",
                 "value": round(tflops, 3),
                 "unit": "TFLOP/s",
                 "vs_baseline": round(tflops / peak, 4),
+                "extra_metrics": [
+                    {
+                        "metric": f"kmeans_fit_{kn}x{kd}_k{kk}_30iter_split0",
+                        "value": round(kmeans_s, 3),
+                        "unit": "s",
+                    },
+                    {
+                        "metric": f"hsvd_rank_{hm}x{hn}_r{hrank}_split1",
+                        "value": round(hsvd_s, 3),
+                        "unit": "s",
+                    },
+                ],
             }
         )
     )

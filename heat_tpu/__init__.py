@@ -17,9 +17,10 @@ import jax as _jax
 _jax.config.update("jax_enable_x64", True)
 
 # The reference computes every matmul in full fp32/fp64 (torch on CPU/GPU). TPU MXUs
-# default to bf16-precision passes; "highest" restores fp32 accumulation for numerics
-# parity. Perf-critical callers opt down locally via jax.default_matmul_precision.
-_jax.config.update("jax_default_matmul_precision", "highest")
+# default to bf16-input passes — fast, and the right default for the framework's bulk
+# compute path. fp32-sensitive algorithms (QR, hSVD, CG/Lanczos, cdist's quadratic
+# expansion) request jax.lax.Precision.HIGHEST per-op instead of a global brake; see
+# heat_tpu.core.linalg.basics.PARITY_PRECISION.
 
 from .core import *
 from .core import __version__

@@ -20,6 +20,10 @@ from ..core.dndarray import DNDarray
 
 __all__ = ["_KCluster"]
 
+# jitted Lloyd programs keyed by (class, k, max_iter, tol, metric); the traced
+# closures depend on nothing else, so instances share compiled code
+_LLOYD_CACHE: dict = {}
+
 
 class _KCluster(ClusteringMixin, BaseEstimator):
     """Shared machinery for KMeans/KMedians/KMedoids (reference ``_kcluster.py:10``)."""
@@ -40,6 +44,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         self.random_state = random_state
 
         self._metric = metric
+        self._metric_kind = "euclidean"  # local-metric name for the jitted Lloyd loop
         self._seed_p = 2  # metric exponent for ++ seeding (1 = manhattan)
         self._cluster_centers = None
         self._labels = None
@@ -111,27 +116,87 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             self._inertia = float(ht.sum(ht.min(distances, axis=1) ** 2).item())
         return labels
 
-    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray):
+    def _update_centroids_local(self, xv, labels, old):
+        """Pure-jnp centroid update, jittable; subclasses implement (the reference's
+        per-estimator ``_update_centroids``, as a pure function of local values)."""
         raise NotImplementedError()
 
     def fit(self, x: DNDarray):
-        """Shared Lloyd-style iteration (reference duplicates this across
+        """Shared Lloyd iteration (reference duplicates this across
         kmeans.py:105/kmedians.py:101/kmedoids.py:118): assign, update, converge when
-        the squared centroid shift drops to ``tol``."""
+        the squared centroid shift drops to ``tol``.
+
+        The entire loop is ONE jitted ``lax.while_loop`` — assignment, update, and the
+        convergence test all stay on device (the reference syncs the host twice per
+        iteration for shift and inertia); the only readbacks are the final
+        ``n_iter``/``inertia`` scalars after convergence.
+        """
         if not isinstance(x, DNDarray):
             raise ValueError(f"input needs to be a DNDarray, but was {type(x)}")
         self._initialize_cluster_centers(x)
-        self._n_iter = 0
-        for _ in range(self.max_iter):
-            matching_centroids = self._assign_to_cluster(x)
-            new_centers = self._update_centroids(x, matching_centroids)
-            self._n_iter += 1
-            shift = float(ht.sum((self._cluster_centers - new_centers) ** 2).item())
-            self._cluster_centers = new_centers
-            if shift <= self.tol:
-                break
-        self._labels = self._assign_to_cluster(x, eval_functional_value=True)
+
+        promoted = ht.promote_types(x.dtype, ht.float32).jax_type()
+        xv = x.larray.astype(promoted)
+        centers0 = self._cluster_centers.larray.astype(promoted)
+        n_iter, centers, labels, inertia = self._lloyd_fn()(xv, centers0)
+        self._n_iter = int(n_iter)
+        self._cluster_centers = ht.array(
+            centers.astype(centers0.dtype), comm=x.comm
+        )
+        from ..core._operations import wrap_result
+
+        self._labels = wrap_result(labels.astype(jnp.int64), x, x.split)
+        self._inertia = float(inertia)
         return self
+
+    def _lloyd_fn(self):
+        """The jitted whole-fit Lloyd program, cached per
+        (estimator class, k, max_iter, tol, metric) so repeated fits hit XLA's
+        compilation cache instead of re-tracing a fresh closure every call."""
+        key = (
+            type(self),
+            self.n_clusters,
+            self.max_iter,
+            float(self.tol),
+            self._metric_kind,
+        )
+        fn = _LLOYD_CACHE.get(key)
+        if fn is not None:
+            return fn
+
+        import jax
+        from jax import lax
+
+        from ..spatial.distance import _pairwise
+
+        metric_kind = self._metric_kind
+        update = self._update_centroids_local
+        max_iter, tol = self.max_iter, float(self.tol)
+
+        @jax.jit
+        def lloyd(xv, centers0):
+            def cond(state):
+                i, _, shift = state
+                return jnp.logical_and(i < max_iter, shift > tol)
+
+            def body(state):
+                i, centers, _ = state
+                d = _pairwise(xv, centers, metric_kind)
+                labels = jnp.argmin(d, axis=1)
+                new = update(xv, labels, centers)
+                shift = jnp.sum((centers - new) ** 2)
+                return i + 1, new, shift
+
+            i, centers, _ = lax.while_loop(
+                cond, body, (jnp.int32(0), centers0, jnp.array(jnp.inf, centers0.dtype))
+            )
+            d = _pairwise(xv, centers, metric_kind)
+            labels = jnp.argmin(d, axis=1)
+            inertia = jnp.sum(jnp.min(d, axis=1) ** 2)
+            return i, centers, labels, inertia
+
+        _LLOYD_CACHE[key] = lloyd
+        return lloyd
 
     def predict(self, x: DNDarray) -> DNDarray:
         """Nearest learned centroid for each sample (reference ``_kcluster.py:298``)."""

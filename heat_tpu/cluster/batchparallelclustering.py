@@ -10,6 +10,7 @@ k-clustering of the concatenated centroid sets.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Union
 from warnings import warn
 
@@ -26,7 +27,10 @@ __all__ = ["BatchParallelKMeans", "BatchParallelKMedians"]
 
 
 def _kmex(X: jax.Array, p: int, n_clusters: int, init, max_iter: int, tol: float, key) -> tuple:
-    """Single-block k-means (p=2) / k-medians (p=1) (reference ``_kmex`` ``:38``)."""
+    """Single-block k-means (p=2) / k-medians (p=1) (reference ``_kmex`` ``:38``).
+
+    The whole iteration runs as one jitted ``lax.while_loop`` — the reference (and the
+    round-1 port) re-entered Python with an ``allclose`` host sync per iteration."""
     if isinstance(init, jax.Array):
         centers = init
     elif init == "++":
@@ -36,24 +40,43 @@ def _kmex(X: jax.Array, p: int, n_clusters: int, init, max_iter: int, tol: float
         centers = X[idx]
     else:
         raise ValueError("init must be an array of initial centers, '++', or 'random'")
-    it = 0
-    for it in range(max_iter):
-        dist = _cdist_p(X, centers, p)
-        labels = jnp.argmin(dist, axis=1)
-        old = centers
-        rows = []
-        for i in range(n_clusters):
-            mask = labels == i
+    centers, it = _kmex_loop(X, centers, p, n_clusters, max_iter, tol)
+    return centers, int(it)
+
+
+@partial(jax.jit, static_argnames=("p", "n_clusters"))
+def _kmex_loop(X, centers0, p, n_clusters, max_iter, tol):
+    def update(labels, old):
+        def one(c):
+            mask = labels == c
             cnt = jnp.sum(mask)
             if p == 1:
                 upd = jnp.nanmedian(jnp.where(mask[:, None], X, jnp.nan), axis=0)
             else:
-                upd = jnp.sum(jnp.where(mask[:, None], X, 0.0), axis=0) / jnp.maximum(cnt, 1)
-            rows.append(jnp.where(cnt > 0, upd.astype(X.dtype), old[i]))
-        centers = jnp.stack(rows)
-        if bool(jnp.allclose(centers, old, atol=tol)):
-            break
-    return centers, it + 1
+                upd = jnp.sum(jnp.where(mask[:, None], X, 0.0), axis=0) / jnp.maximum(
+                    cnt, 1
+                )
+            return jnp.where(cnt > 0, upd.astype(X.dtype), jnp.take(old, c, axis=0))
+
+        return jax.vmap(one)(jnp.arange(n_clusters))
+
+    def cond(state):
+        i, _, done = state
+        return jnp.logical_and(i < max_iter, jnp.logical_not(done))
+
+    def body(state):
+        i, centers, _ = state
+        labels = jnp.argmin(_cdist_p(X, centers, p), axis=1)
+        new = update(labels, centers)
+        # allclose semantics (atol + rtol·|old|), matching the pre-jit loop's
+        # jnp.allclose(new, old, atol=tol) so large-magnitude data still converges
+        done = jnp.all(jnp.abs(new - centers) <= tol + 1e-5 * jnp.abs(centers))
+        return i + 1, new, done
+
+    i, centers, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), centers0, jnp.bool_(False))
+    )
+    return centers, i
 
 
 def _cdist_p(x: jax.Array, y: jax.Array, p: int) -> jax.Array:

@@ -39,17 +39,14 @@ class KMeans(_KCluster):
             random_state=random_state,
         )
 
-    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
+    def _update_centroids_local(self, xv, labels, old):
         """Masked mean per cluster (reference ``kmeans.py:76-103``): a segment-sum the
-        compiler turns into one psum across shards."""
-        xv = x.larray
-        labels = matching_centroids.larray.reshape(-1)
+        compiler turns into one psum across shards; pure jnp so the whole Lloyd loop
+        jits as one program."""
         k = self.n_clusters
         sums = jnp.zeros((k, xv.shape[1]), xv.dtype).at[labels].add(xv)
         counts = jnp.zeros((k,), xv.dtype).at[labels].add(1.0)
         new = sums / jnp.maximum(counts[:, None], 1.0)
         # keep old center for empty clusters
-        old = self._cluster_centers.larray
-        new = jnp.where(counts[:, None] > 0, new, old)
-        return ht.array(new, comm=x.comm)
+        return jnp.where(counts[:, None] > 0, new, old)
 

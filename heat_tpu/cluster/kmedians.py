@@ -36,19 +36,20 @@ class KMedians(_KCluster):
             random_state=random_state,
         )
         self._seed_p = 1  # seed with the manhattan metric the estimator optimizes
+        self._metric_kind = "manhattan"
 
-    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
-        """Coordinate-wise median per cluster (reference ``kmedians.py:71-99``)."""
-        xv = x.larray
-        labels = matching_centroids.larray.reshape(-1)
-        old = self._cluster_centers.larray
-        new_rows = []
-        for c in range(self.n_clusters):
+    def _update_centroids_local(self, xv, labels, old):
+        """Coordinate-wise median per cluster (reference ``kmedians.py:71-99``),
+        vmapped over the cluster index."""
+        import jax
+
+        def one(c):
             mask = labels == c
             cnt = jnp.sum(mask)
             # nan-masked median so the global op keeps a static shape
             masked = jnp.where(mask[:, None], xv, jnp.nan)
             med = jnp.nanmedian(masked, axis=0)
-            new_rows.append(jnp.where(cnt > 0, med.astype(old.dtype), old[c]))
-        return ht.array(jnp.stack(new_rows), comm=x.comm)
+            return jnp.where(cnt > 0, med.astype(old.dtype), jnp.take(old, c, axis=0))
+
+        return jax.vmap(one)(jnp.arange(self.n_clusters))
 

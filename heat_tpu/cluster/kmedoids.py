@@ -35,22 +35,18 @@ class KMedoids(_KCluster):
             random_state=random_state,
         )
 
-    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
+    def _update_centroids_local(self, xv, labels, old):
         """Mean per cluster, then snap to the closest sample (reference
-        ``kmedoids.py:69-116``)."""
-        xv = x.larray
-        labels = matching_centroids.larray.reshape(-1)
+        ``kmedoids.py:69-116``); pure jnp for the jitted Lloyd loop."""
         k = self.n_clusters
         sums = jnp.zeros((k, xv.shape[1]), xv.dtype).at[labels].add(xv)
         counts = jnp.zeros((k,), xv.dtype).at[labels].add(1.0)
         means = sums / jnp.maximum(counts[:, None], 1.0)
-        old = self._cluster_centers.larray
         means = jnp.where(counts[:, None] > 0, means, old)
         # snap each mean to the nearest point of its own cluster
         d = jnp.sum((xv[:, None, :] - means[None, :, :]) ** 2, axis=-1)  # (n, k)
         d = jnp.where(labels[:, None] == jnp.arange(k)[None, :], d, jnp.inf)
         nearest = jnp.argmin(d, axis=0)  # (k,)
         snapped = xv[nearest]
-        snapped = jnp.where(counts[:, None] > 0, snapped, old)
-        return ht.array(snapped, comm=x.comm)
+        return jnp.where(counts[:, None] > 0, snapped, old)
 

@@ -9,6 +9,7 @@ from typing import Optional, Union
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 import heat_tpu as ht
@@ -78,7 +79,7 @@ class Spectral(ClusteringMixin, BaseEstimator):
         V, T = ht.linalg.lanczos(L, m, v0)
         evals, evecs = jnp.linalg.eigh(T.larray)
         # ascending eigenvalues; embed on the smallest
-        components = V.larray @ evecs
+        components = jnp.matmul(V.larray, evecs, precision=jax.lax.Precision.HIGHEST)
         return ht.array(evals, comm=x.comm), ht.array(components, comm=x.comm)
 
     def fit(self, x: DNDarray) -> "Spectral":

@@ -42,6 +42,12 @@ __all__ = [
 ]
 
 
+# Per-op precision for numerics-parity-critical matmuls (the reference computes in
+# full fp32/fp64 via torch). The MXU's bf16-input default is kept for the bulk
+# compute path; decompositions and cancellation-prone kernels opt up to this.
+PARITY_PRECISION = jax.lax.Precision.HIGHEST
+
+
 def _wrap_like(value: jax.Array, proto: DNDarray, split: Optional[int]) -> DNDarray:
     if split is not None and (split >= value.ndim or split < 0):
         split = None
@@ -51,17 +57,22 @@ def _wrap_like(value: jax.Array, proto: DNDarray, split: Optional[int]) -> DNDar
     )
 
 
-def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
+def matmul(
+    a: DNDarray, b: DNDarray, allow_resplit: bool = False, precision=None
+) -> DNDarray:
     """Matrix multiplication of distributed operands (reference ``basics.py:422``).
 
     Output split rule: a row-split ``a`` yields a row-split product; a column-split ``b``
     yields a column-split product; contraction-dim splits all-reduce away to ``None``;
     batch-dim splits are preserved. The data movement itself is XLA SPMD's choice
     (typically all-gather of the smaller panel riding ICI).
+
+    ``precision`` passes through to ``jnp.matmul`` — ``None`` uses the MXU-native
+    default; pass :data:`PARITY_PRECISION` for the reference's full-fp32 behavior.
     """
     sanitation.sanitize_in(a)
     sanitation.sanitize_in(b)
-    result = jnp.matmul(a.larray, b.larray)
+    result = jnp.matmul(a.larray, b.larray, precision=precision)
     nd_out = result.ndim
     # position of a's row dim / b's col dim in the output (absent for 1-D operands)
     row_dim = nd_out - (2 if b.ndim >= 2 else 1) if a.ndim >= 2 else None
@@ -80,20 +91,22 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
     return _wrap_like(result, a, split)
 
 
-def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDarray, float]:
+def dot(
+    a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None, precision=None
+) -> Union[DNDarray, float]:
     """Dot product (reference ``basics.py:245``): inner product for 1-D, matmul for 2-D."""
     if isinstance(a, (int, float)) or isinstance(b, (int, float)) or a.ndim == 0 or b.ndim == 0:
         from .. import arithmetics
 
         return arithmetics.mul(a, b)
     if a.ndim == 1 and b.ndim == 1:
-        result = jnp.dot(a.larray, b.larray)
+        result = jnp.dot(a.larray, b.larray, precision=precision)
         res = _wrap_like(result, a, None)
         if out is not None:
             out.larray = res.larray
             return out
         return res
-    ret = matmul(a, b)
+    ret = matmul(a, b, precision=precision)
     if out is not None:
         out.larray = ret.larray
         return out

@@ -102,7 +102,10 @@ def _tsqr(x: jax.Array, nblocks: int, calc_q: bool = True) -> Tuple[Optional[jax
     k = r1.shape[1]
     q2, r = jnp.linalg.qr(r1.reshape(nblocks * k, n), mode="reduced")
     q2 = q2.reshape(nblocks, k, q2.shape[1])
-    q = jnp.einsum("bik,bkj->bij", q1, q2).reshape(nblocks * rows, q2.shape[2])
+    # full-precision combine: orthogonality of Q must hold to f32, not bf16-input, ulp
+    q = jnp.einsum(
+        "bik,bkj->bij", q1, q2, precision=jax.lax.Precision.HIGHEST
+    ).reshape(nblocks * rows, q2.shape[2])
     if pad:
         q = q[:m]
     return q, r

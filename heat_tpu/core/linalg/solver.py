@@ -12,11 +12,19 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from functools import partial
+
 from .. import factories, types
 from ..dndarray import DNDarray
-from .basics import dot, matmul, norm, transpose
+from .basics import PARITY_PRECISION, norm, transpose
+from .basics import dot as _dot
+from .basics import matmul as _matmul
 
 __all__ = ["cg", "lanczos"]
+
+# iterative solvers accumulate rounding across iterations: full fp32 matvecs/dots
+matmul = partial(_matmul, precision=PARITY_PRECISION)
+dot = partial(_dot, precision=PARITY_PRECISION)
 
 
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:

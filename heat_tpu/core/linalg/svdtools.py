@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from .. import factories, types
 from ..dndarray import DNDarray
-from .basics import matmul, vector_norm
+from .basics import PARITY_PRECISION, matmul, vector_norm
 
 __all__ = ["hsvd", "hsvd_rank", "hsvd_rtol"]
 
@@ -120,56 +120,59 @@ def hsvd(
     # per-level absolute tolerance (reference: rtol * ||A|| / sqrt(2*nblocks-1))
     loc_atol = None if rtol is None else rtol * Anorm / np.sqrt(2 * nblocks - 1)
 
-    # level 0: truncated SVD of each rank's column block (whole array if replicated)
+    # level 0: truncated SVD of each rank's column block (whole array if replicated).
+    # All blocks of a level go through ONE batched SVD (zero-padded to a common
+    # width — zero columns add exact-zero singular values, removed by truncation)
+    # and ONE host readback of the singular values for the truncation decisions;
+    # the reference runs P sequential device round-trips here (svdtools.py:341).
     if nblocks == 1:
         nodes: List[jax.Array] = [x]
     else:
         bounds = [work.comm.chunk((m, n), 1, rank=r)[2][1] for r in range(nblocks)]
         nodes = [x[:, sl] for sl in bounds]
     level = 0
-    err_squared = [0.0] * len(nodes)
-    sigmas: List[jax.Array] = [None] * len(nodes)
-    new_nodes, new_err, new_sig = [], [], []
-    for i, blk in enumerate(nodes):
-        u, s, e = _local_truncated_svd(level, i, blk, maxrank, loc_atol, safetyshift, silent)
-        new_nodes.append(u * s)  # carry U·diag(sigma) into the merges, like the Sends
-        new_err.append(e)
-        new_sig.append(s)
-    nodes, err_squared, sigmas = new_nodes, new_err, new_sig
+    outs = _batched_truncated_svd(level, nodes, maxrank, loc_atol, safetyshift, silent)
+    nodes = [u * s for u, s, _ in outs]  # carry U·diag(sigma) into the merges
+    err_squared = [e for _, _, e in outs]
+    sigmas = [s for _, s, _ in outs]
 
     arity = no_of_merges or 2
     while len(nodes) > 1:
         level += 1
-        merged_nodes, merged_err, merged_sig = [], [], []
+        # merge-budget scheduling (reference svdtools.py:357-382) needs only the node
+        # *widths*, which are static shapes — pure host logic, no device sync
+        groups, keep = [], []
         i = 0
         while i < len(nodes):
-            group = [nodes[i]]
-            group_err = err_squared[i]
+            group_idx = [i]
             width = nodes[i].shape[1]
             j = i + 1
-            # merge-budget scheduling (reference svdtools.py:357-382): grow the group
-            # while the concatenation stays under maxmergedim and the arity cap
             while (
                 j < len(nodes)
-                and len(group) < arity
+                and len(group_idx) < arity
                 and (maxmergedim is None or width + nodes[j].shape[1] <= maxmergedim)
             ):
-                group.append(nodes[j])
-                group_err += err_squared[j]
+                group_idx.append(j)
                 width += nodes[j].shape[1]
                 j += 1
-            if len(group) == 1:
-                merged_nodes.append(group[0])
-                merged_err.append(group_err)
-                merged_sig.append(sigmas[i])
-            else:
-                cat = jnp.concatenate(group, axis=1)
-                u, s, e = _local_truncated_svd(level, i, cat, maxrank, loc_atol, safetyshift, silent)
-                merged_nodes.append(u * s)
-                merged_err.append(group_err + e)
-                merged_sig.append(s)
+            (groups if len(group_idx) > 1 else keep).append(group_idx)
             i = j
-        nodes, err_squared, sigmas = merged_nodes, merged_err, merged_sig
+        cats = [jnp.concatenate([nodes[k] for k in g], axis=1) for g in groups]
+        outs = (
+            _batched_truncated_svd(level, cats, maxrank, loc_atol, safetyshift, silent)
+            if cats
+            else []
+        )
+        merged = {}
+        for g, (u, s, e) in zip(groups, outs):
+            merged[g[0]] = (u * s, sum(err_squared[k] for k in g) + e, s)
+        for g in keep:
+            k = g[0]
+            merged[k] = (nodes[k], err_squared[k], sigmas[k])
+        order = sorted(merged)
+        nodes = [merged[k][0] for k in order]
+        err_squared = [merged[k][1] for k in order]
+        sigmas = [merged[k][2] for k in order]
 
     # final truncation removes the safetyshift (reference svdtools.py:419-421)
     final_u, final_sigma, final_err = _local_truncated_svd(
@@ -186,18 +189,87 @@ def hsvd(
     # postprocessing (reference svdtools.py:457-470)
     if transposeflag or compute_sv:
         work_dnd = A.T if transposeflag else A
-        V = matmul(work_dnd.T, U)
+        V = matmul(work_dnd.T, U, precision=PARITY_PRECISION)
         sigma = vector_norm(V, axis=0)
         if float(vector_norm(sigma).item()) > 0:
             from ..manipulations import diag
 
-            V = matmul(V, diag(1.0 / sigma))
+            V = matmul(V, diag(1.0 / sigma), precision=PARITY_PRECISION)
         if transposeflag:
             if compute_sv:
                 return V, sigma, U, rel_error_estimate
             return V, rel_error_estimate
         return U, sigma, V, rel_error_estimate
     return U, rel_error_estimate
+
+
+def _batched_truncated_svd(
+    level: int,
+    blocks: List[jax.Array],
+    maxrank: int,
+    loc_atol: Optional[float],
+    safetyshift: int,
+    silent: bool = True,
+) -> List[Tuple[jax.Array, jax.Array, float]]:
+    """Truncated SVDs of one whole tree level (reference runs
+    ``compute_local_truncated_svd`` ``svdtools.py:478`` per node, each with its own
+    host sync): blocks are zero-padded to a common width, factored by ONE batched
+    ``jnp.linalg.svd``, and the singular values cross to host in ONE transfer for the
+    noise-floor / rank / atol truncation decisions. Per node, returns
+    ``(U_trunc, sigma_trunc, err²_dropped)``."""
+    wmax = max(b.shape[1] for b in blocks)
+    stacked = jnp.stack(
+        [
+            jnp.pad(b, ((0, 0), (0, wmax - b.shape[1]))) if b.shape[1] < wmax else b
+            for b in blocks
+        ]
+    )
+    if jax.default_backend() != "cpu" and stacked.dtype == jnp.float32:
+        # TPU workaround: the float32 SVD lowering SIGABRTs the TPU compiler when
+        # global x64 mode is on (int64 index types); trace this op in x32 scope
+        with jax.enable_x64(False):
+            u, s, _ = jnp.linalg.svd(stacked, full_matrices=False)
+    else:
+        u, s, _ = jnp.linalg.svd(stacked, full_matrices=False)
+    noiselevel = 1e-14 if stacked.dtype == jnp.float64 else 1e-7
+    s_all = np.asarray(s)  # the level's single host sync
+
+    results: List[Tuple[jax.Array, jax.Array, float]] = []
+    for node_id, blk in enumerate(blocks):
+        s_np = s_all[node_id]
+        above = np.nonzero(s_np >= noiselevel)[0]
+        if len(above) == 0:
+            err = float(np.linalg.norm(s_np) ** 2)
+            results.append(
+                (
+                    jnp.zeros((blk.shape[0], 1), blk.dtype),
+                    jnp.zeros((1,), blk.dtype),
+                    err,
+                )
+            )
+            continue
+        cut_noise_rank = int(above.max()) + 1
+        if loc_atol is None:
+            trunc = min(maxrank, cut_noise_rank)
+        else:
+            tails = np.array(
+                [np.linalg.norm(s_np[k:]) ** 2 for k in range(len(s_np) + 1)]
+            )
+            ideal = int(np.nonzero(tails < loc_atol**2)[0].min())
+            trunc = min(maxrank, ideal, cut_noise_rank)
+            if trunc != ideal and not silent:
+                print(
+                    f"in hSVD (level {level}, node {node_id}): atol requires rank "
+                    f"{ideal}, but maxrank={maxrank}. Loss of desired precision likely!"
+                )
+        trunc = min(len(s_np), trunc + safetyshift)
+        # squared energy actually discarded at this node. The reference charges the
+        # kept safety-shift columns too (``sigma_loc[loc_trunc_rank - safetyshift:]``,
+        # svdtools.py:525), double-counting them against the final truncation; counting
+        # only the dropped tail keeps the estimate an upper bound and makes it tight.
+        err = float(np.linalg.norm(s_np[trunc:]) ** 2)
+        results.append((u[node_id, :, :trunc], s[node_id, :trunc], err))
+    return results
 
 
 def _local_truncated_svd(
@@ -209,42 +281,6 @@ def _local_truncated_svd(
     safetyshift: int,
     silent: bool = True,
 ) -> Tuple[jax.Array, jax.Array, float]:
-    """Truncated SVD of one tree node (reference ``compute_local_truncated_svd``
-    ``svdtools.py:478``): noise-floor cut, rank/atol truncation, safety shift, and the
-    squared truncation error of what was dropped."""
-    if jax.default_backend() != "cpu" and x.dtype == jnp.float32:
-        # TPU workaround: the float32 SVD lowering SIGABRTs the TPU compiler when
-        # global x64 mode is on (int64 index types); trace this op in x32 scope
-        with jax.enable_x64(False):
-            u, s, _ = jnp.linalg.svd(x, full_matrices=False)
-    else:
-        u, s, _ = jnp.linalg.svd(x, full_matrices=False)
-    noiselevel = 1e-14 if x.dtype == jnp.float64 else 1e-7
-    s_np = np.asarray(s)
-    above = np.nonzero(s_np >= noiselevel)[0]
-    if len(above) == 0:
-        err = float(np.linalg.norm(s_np) ** 2)
-        return (
-            jnp.zeros((x.shape[0], 1), x.dtype),
-            jnp.zeros((1,), x.dtype),
-            err,
-        )
-    cut_noise_rank = int(above.max()) + 1
-    if loc_atol is None:
-        trunc = min(maxrank, cut_noise_rank)
-    else:
-        tails = np.array([np.linalg.norm(s_np[k:]) ** 2 for k in range(len(s_np) + 1)])
-        ideal = int(np.nonzero(tails < loc_atol**2)[0].min())
-        trunc = min(maxrank, ideal, cut_noise_rank)
-        if trunc != ideal and not silent:
-            print(
-                f"in hSVD (level {level}, node {node_id}): atol requires rank {ideal}, "
-                f"but maxrank={maxrank}. Loss of desired precision likely!"
-            )
-    trunc = min(len(s_np), trunc + safetyshift)
-    # squared energy actually discarded at this node. The reference charges the kept
-    # safety-shift columns too (``sigma_loc[loc_trunc_rank - safetyshift:]``,
-    # svdtools.py:525), double-counting them against the final truncation; counting only
-    # the dropped tail keeps the estimate an upper bound and makes it tight.
-    err = float(np.linalg.norm(s_np[trunc:]) ** 2)
-    return u[:, :trunc], s[:trunc], err
+    """Single-node wrapper over :func:`_batched_truncated_svd` (kept for the final
+    root truncation and for direct testing)."""
+    return _batched_truncated_svd(level, [x], maxrank, loc_atol, safetyshift, silent)[0]

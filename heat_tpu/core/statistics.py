@@ -17,6 +17,7 @@ from typing import Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from . import _operations, sanitation, types
@@ -174,7 +175,8 @@ def cov(m: DNDarray, y: Optional[DNDarray] = None, rowvar: bool = True, bias: bo
     n = x.shape[1]
     xm = x - jnp.mean(x, axis=1, keepdims=True)
     fact = builtins_max(n - ddof, 0)
-    result = (xm @ xm.conj().T) / fact
+    # full input precision: covariance entries cancel for correlated variables
+    result = jnp.matmul(xm, xm.conj().T, precision=jax.lax.Precision.HIGHEST) / fact
     if result.shape == (1, 1):  # numpy returns a 0-d value for a single variable
         result = result.reshape(())
     return _wrap(result, m, None)

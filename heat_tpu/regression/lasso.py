@@ -5,6 +5,7 @@ from __future__ import annotations
 
 from typing import Optional, Union
 
+import jax
 import jax.numpy as jnp
 
 import heat_tpu as ht
@@ -75,8 +76,15 @@ class Lasso(RegressionMixin, BaseEstimator):
         for it in range(self.max_iter):
             theta_old = theta
             for j in range(n):
-                resid_j = yv - xv @ theta + xv[:, j] * theta[j]
-                rho = jnp.dot(xv[:, j], resid_j) / jnp.maximum(colnorm2[j], 1e-300)
+                # full-precision matvec: the residual is iterated on, rounding compounds
+                resid_j = (
+                    yv
+                    - jnp.matmul(xv, theta, precision=jax.lax.Precision.HIGHEST)
+                    + xv[:, j] * theta[j]
+                )
+                rho = jnp.dot(
+                    xv[:, j], resid_j, precision=jax.lax.Precision.HIGHEST
+                ) / jnp.maximum(colnorm2[j], 1e-300)
                 if j == 0:  # intercept column is not penalized (reference lasso.py:150)
                     theta = theta.at[0].set(rho)
                 else:

@@ -30,7 +30,9 @@ def _pairwise(x: jax.Array, y: jax.Array, metric: str, p: float = 2.0) -> jax.Ar
         # _euclidian_fast (distance.py:32) — one big MXU matmul instead of O(n²d) substracts
         xx = jnp.sum(x * x, axis=1)[:, None]
         yy = jnp.sum(y * y, axis=1)[None, :]
-        sq = xx + yy - 2.0 * (x @ y.T)
+        # the expansion cancels catastrophically for near points — the cross term
+        # needs full input precision, not the MXU's bf16-input default
+        sq = xx + yy - 2.0 * jnp.matmul(x, y.T, precision=jax.lax.Precision.HIGHEST)
         return jnp.sqrt(jnp.maximum(sq, 0.0))
     if metric == "manhattan":
         return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
