@@ -2,9 +2,12 @@
 
 The reference's ``_dist`` (``distance.py:209``) is a ring algorithm: each rank holds an
 X-chunk, Y-chunks rotate around the ranks with Send/Recv, one local torch.cdist per
-step. On TPU the ring is exactly what XLA emits for the sharded pairwise computation —
-a collective-permute pipeline over the ICI torus — so ``cdist`` is a single fused
-broadcast-subtract-reduce on global arrays, with the output row-split following X.
+step. Here both formulations exist: when X and Y are row-split and divide the mesh,
+:func:`_ring_pairwise` runs that exact schedule explicitly (``ppermute`` hops around
+the ICI ring, O(n_y/P) resident Y per device); every other split combination — feature
+splits, unsplit operands, ragged sizes — is the SPMD-global formulation where XLA
+inserts the gathers. Output split: row-split X → split 0; else row-split Y → split 1;
+else replicated.
 """
 
 from __future__ import annotations
@@ -39,30 +42,94 @@ def _pairwise(x: jax.Array, y: jax.Array, metric: str, p: float = 2.0) -> jax.Ar
     raise ValueError(f"unknown metric {metric}")
 
 
+def _ring_pairwise(comm, xv: jax.Array, yv: jax.Array, metric: str) -> jax.Array:
+    """Distance matrix via a ring rotation of Y shards under ``shard_map`` — the
+    explicit TPU form of the reference's ring algorithm (``_dist`` ``distance.py:209``:
+    X-chunks stay put, Y-chunks travel rank-to-rank with Send/Recv).
+
+    Each device holds its X shard and, per step, one visiting Y shard; ``ppermute``
+    moves the Y shards one hop around the ICI ring. Peak memory per device is
+    O(n_y/P) for Y instead of the all-gathered O(n_y) the SPMD-global formulation
+    materialises — the reason the reference uses a ring, preserved here.
+    """
+    from jax.sharding import PartitionSpec
+
+    axis = comm.axis_name
+    nproc = comm.size
+    ny_chunk = yv.shape[0] // nproc
+
+    def ring(xl, yl):
+        idx = jax.lax.axis_index(axis)
+        # mark the accumulator device-varying so the loop carry type is stable
+        out0 = jax.lax.pcast(
+            jnp.zeros((xl.shape[0], yv.shape[0]), xl.dtype), (axis,), to="varying"
+        )
+
+        def fill(i, yblk, out):
+            src = (idx - i) % nproc  # whose Y block this device holds at step i
+            d = _pairwise(xl, yblk, metric)
+            return jax.lax.dynamic_update_slice(
+                out, d, (jnp.int32(0), (src * ny_chunk).astype(jnp.int32))
+            )
+
+        def step(i, carry):
+            yblk, out = carry
+            out = fill(i, yblk, out)
+            return comm.ring_shift(yblk, 1, axis_name=axis), out
+
+        # nproc-1 rotations; the last block is consumed without a wasted final hop
+        yblk, out = jax.lax.fori_loop(0, nproc - 1, step, (yl, out0))
+        return fill(nproc - 1, yblk, out)
+
+    return jax.shard_map(
+        ring,
+        mesh=comm.mesh,
+        in_specs=(PartitionSpec(axis, None), PartitionSpec(axis, None)),
+        out_specs=PartitionSpec(axis, None),
+    )(xv, yv)
+
+
 def _dist(X: DNDarray, Y: Optional[DNDarray], metric: str) -> DNDarray:
-    """Shared driver (reference ``_dist`` ``distance.py:209``)."""
+    """Shared driver (reference ``_dist`` ``distance.py:209``).
+
+    Any (X.split, Y.split) combination is accepted: split feature axes are a
+    contraction XLA resolves, a row-split X yields a row-split result, and the
+    both-row-split case runs the explicit :func:`_ring_pairwise` schedule when the
+    shapes divide the mesh evenly (falling back to the SPMD-global formulation
+    otherwise)."""
     sanitize_in(X)
     if X.ndim != 2:
         raise NotImplementedError(f"X should be 2D, but is {X.ndim}D")
-    if X.split is not None and X.split != 0:
-        raise NotImplementedError("Input split was not 0")
     promoted = types.promote_types(X.dtype, types.float32)
     xv = X.larray.astype(promoted.jax_type())
     if Y is None:
+        y_split = X.split
         yv = xv
     else:
         sanitize_in(Y)
         if Y.ndim != 2:
             raise NotImplementedError(f"Y should be 2D, but is {Y.ndim}D")
-        if Y.split is not None and Y.split != 0:
-            raise NotImplementedError("Input split was not 0")
         p2 = types.promote_types(Y.dtype, types.float32)
         if p2 is not promoted:
             promoted = types.promote_types(promoted, p2)
             xv = xv.astype(promoted.jax_type())
+        y_split = Y.split
         yv = Y.larray.astype(promoted.jax_type())
-    result = _pairwise(xv, yv, metric)
-    return wrap_result(result, X, 0 if X.split is not None else None)
+    comm = X.comm
+    use_ring = (
+        X.split == 0
+        and y_split == 0
+        and X.is_distributed()
+        and not getattr(comm, "is_hierarchical", False)
+        and xv.shape[0] % comm.size == 0
+        and yv.shape[0] % comm.size == 0
+    )
+    if use_ring:
+        result = _ring_pairwise(comm, xv, yv, metric)
+    else:
+        result = _pairwise(xv, yv, metric)
+    out_split = 0 if X.split == 0 else (1 if y_split == 0 else None)
+    return wrap_result(result, X, out_split)
 
 
 def cdist(X: DNDarray, Y: Optional[DNDarray] = None, quadratic_expansion: bool = False) -> DNDarray:

@@ -38,8 +38,12 @@ class TestSpatial(TestCase):
     def test_cdist_errors(self):
         with self.assertRaises(NotImplementedError):
             ht.spatial.cdist(ht.ones((4, 4, 4)))
-        with self.assertRaises(NotImplementedError):
-            ht.spatial.cdist(ht.ones((4, 4), split=1))
+
+    def test_cdist_feature_split(self):
+        # split=1 (feature-split) inputs are supported now — a contraction XLA resolves
+        d = ht.spatial.cdist(ht.ones((4, 4), split=1))
+        self.assertEqual(d.shape, (4, 4))
+        np.testing.assert_allclose(d.numpy(), np.zeros((4, 4)), atol=1e-6)
 
 
 class TestKClustering(TestCase):
