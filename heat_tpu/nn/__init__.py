@@ -4,4 +4,4 @@ native module system in :mod:`.modules` is the fallthrough surface here."""
 
 from .data_parallel import *
 from .modules import *
-from . import data_parallel, modules
+from . import data_parallel, functional, modules

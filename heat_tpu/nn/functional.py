@@ -1,0 +1,290 @@
+"""Functional nn ops (reference ``heat.nn.functional`` is ``torch.nn.functional``
+via the fall-through in ``heat/nn/__init__.py:18-31``; the reference MNIST example
+uses ``F.relu``/``F.max_pool2d``/``F.log_softmax``/``F.nll_loss``,
+``examples/nn/mnist.py:26-43``).
+
+Every function accepts a ``jax.Array`` or a :class:`DNDarray` (unwrapped, computed
+globally, re-wrapped with the batch split preserved). Shapes follow torch NCHW
+conventions; the convs/pools lower to XLA ops that tile onto the MXU/VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dndarray import DNDarray
+
+__all__ = [
+    "relu",
+    "leaky_relu",
+    "gelu",
+    "elu",
+    "sigmoid",
+    "tanh",
+    "softmax",
+    "log_softmax",
+    "linear",
+    "conv2d",
+    "max_pool2d",
+    "avg_pool2d",
+    "dropout",
+    "dropout2d",
+    "batch_norm",
+    "layer_norm",
+    "flatten",
+    "one_hot",
+    "nll_loss",
+    "cross_entropy",
+    "mse_loss",
+    "l1_loss",
+]
+
+
+def _pair(v) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _unwrap(x):
+    return (x.larray, x) if isinstance(x, DNDarray) else (x, None)
+
+
+def _rewrap(value, proto: Optional[DNDarray], split_rule="batch"):
+    if proto is None:
+        return value
+    from ..core._operations import wrap_result
+
+    split = proto.split if proto.split == 0 else None
+    if split_rule == "scalar":
+        split = None
+    return wrap_result(value, proto, split)
+
+
+def _elementwise(fn):
+    def wrapped(x, *args, **kwargs):
+        v, proto = _unwrap(x)
+        out = fn(v, *args, **kwargs)
+        if proto is None:
+            return out
+        from ..core._operations import wrap_result
+
+        return wrap_result(out, proto, proto.split)
+
+    return wrapped
+
+
+relu = _elementwise(jax.nn.relu)
+gelu = _elementwise(jax.nn.gelu)
+elu = _elementwise(jax.nn.elu)
+sigmoid = _elementwise(jax.nn.sigmoid)
+tanh = _elementwise(jnp.tanh)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    v, proto = _unwrap(x)
+    out = jax.nn.leaky_relu(v, negative_slope)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def softmax(x, dim: int = -1):
+    v, proto = _unwrap(x)
+    out = jax.nn.softmax(v, axis=dim)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def log_softmax(x, dim: int = -1):
+    v, proto = _unwrap(x)
+    out = jax.nn.log_softmax(v, axis=dim)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def linear(x, weight, bias=None):
+    """``y = x @ W.T + b`` with torch's (out, in) weight layout."""
+    v, proto = _unwrap(x)
+    out = v @ weight.T
+    if bias is not None:
+        out = out + bias
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups: int = 1):
+    """2-D convolution, torch semantics: x (N,C,H,W), weight (O, C/groups, kH, kW)."""
+    v, proto = _unwrap(x)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    out = jax.lax.conv_general_dilated(
+        v,
+        weight.astype(v.dtype),
+        window_strides=(sh, sw),
+        padding=((ph, ph), (pw, pw)),
+        rhs_dilation=(dh, dw),
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    if bias is not None:
+        out = out + bias.astype(out.dtype).reshape(1, -1, 1, 1)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0):
+    """Max pooling over the two trailing spatial dims (torch semantics)."""
+    v, proto = _unwrap(x)
+    kh, kw = _pair(kernel_size)
+    sh, sw = _pair(stride) if stride is not None else (kh, kw)
+    ph, pw = _pair(padding)
+    out = jax.lax.reduce_window(
+        v,
+        -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min,
+        jax.lax.max,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sh, sw),
+        padding=((0, 0), (0, 0), (ph, ph), (pw, pw)),
+    )
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0):
+    """Average pooling over the two trailing spatial dims (torch semantics:
+    zero-padded positions count toward the divisor)."""
+    v, proto = _unwrap(x)
+    kh, kw = _pair(kernel_size)
+    sh, sw = _pair(stride) if stride is not None else (kh, kw)
+    ph, pw = _pair(padding)
+    out = jax.lax.reduce_window(
+        v,
+        jnp.zeros((), v.dtype),
+        jax.lax.add,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, sh, sw),
+        padding=((0, 0), (0, 0), (ph, ph), (pw, pw)),
+    ) / (kh * kw)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def dropout(x, p: float = 0.5, training: bool = True, key: Optional[jax.Array] = None):
+    v, proto = _unwrap(x)
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        raise ValueError("dropout in training mode needs an explicit PRNG key")
+    keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+    out = jnp.where(keep, v / (1.0 - p), 0.0)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def dropout2d(x, p: float = 0.5, training: bool = True, key: Optional[jax.Array] = None):
+    """Channel dropout: zeroes entire (N, C) feature maps (torch.nn.Dropout2d)."""
+    v, proto = _unwrap(x)
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        raise ValueError("dropout2d in training mode needs an explicit PRNG key")
+    mask_shape = v.shape[:2] + (1,) * (v.ndim - 2)
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+    out = jnp.where(keep, v / (1.0 - p), 0.0)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.1, eps: float = 1e-5):
+    """Batch normalization over all dims except the channel dim (dim 1).
+
+    Returns ``(out, batch_mean, batch_var)`` — the stats so stateful callers can
+    maintain running estimates (jax arrays are immutable; there is no in-place
+    buffer update like torch's)."""
+    v, proto = _unwrap(x)
+    axes = (0,) + tuple(range(2, v.ndim))
+    if training or running_mean is None:
+        mean = jnp.mean(v, axis=axes)
+        var = jnp.var(v, axis=axes)
+    else:
+        mean, var = running_mean, running_var
+    shape = (1, -1) + (1,) * (v.ndim - 2)
+    out = (v - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    out = _rewrap(out, proto) if proto is not None else out
+    return out, mean, var
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, eps: float = 1e-5):
+    v, proto = _unwrap(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(v.ndim - len(normalized_shape), v.ndim))
+    mean = jnp.mean(v, axis=axes, keepdims=True)
+    var = jnp.var(v, axis=axes, keepdims=True)
+    out = (v - mean) / jnp.sqrt(var + eps)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def flatten(x, start_dim: int = 0, end_dim: int = -1):
+    v, proto = _unwrap(x)
+    nd = v.ndim
+    end = end_dim if end_dim >= 0 else nd + end_dim
+    shape = v.shape[:start_dim] + (-1,) + v.shape[end + 1 :]
+    out = v.reshape(shape)
+    if proto is not None:
+        split = proto.split if proto.split is not None and proto.split < start_dim else (
+            0 if proto.split == 0 else None
+        )
+        from ..core._operations import wrap_result
+
+        return wrap_result(out, proto, split)
+    return out
+
+
+def one_hot(x, num_classes: int):
+    v, proto = _unwrap(x)
+    out = jax.nn.one_hot(v, num_classes)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def nll_loss(log_probs, target, reduction: str = "mean"):
+    """Negative log likelihood over log-probabilities (torch semantics)."""
+    lp, _ = _unwrap(log_probs)
+    t, _ = _unwrap(target)
+    picked = jnp.take_along_axis(lp, t[:, None].astype(jnp.int64), axis=1)[:, 0]
+    if reduction == "mean":
+        return -jnp.mean(picked)
+    if reduction == "sum":
+        return -jnp.sum(picked)
+    return -picked
+
+
+def cross_entropy(logits, target, reduction: str = "mean"):
+    lg, _ = _unwrap(logits)
+    return nll_loss(jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1), target, reduction)
+
+
+def mse_loss(pred, target, reduction: str = "mean"):
+    p, _ = _unwrap(pred)
+    t, _ = _unwrap(target)
+    sq = (p - t) ** 2
+    if reduction == "mean":
+        return jnp.mean(sq)
+    if reduction == "sum":
+        return jnp.sum(sq)
+    return sq
+
+
+def l1_loss(pred, target, reduction: str = "mean"):
+    p, _ = _unwrap(pred)
+    t, _ = _unwrap(target)
+    d = jnp.abs(p - t)
+    if reduction == "mean":
+        return jnp.mean(d)
+    if reduction == "sum":
+        return jnp.sum(d)
+    return d

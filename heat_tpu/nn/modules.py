@@ -23,14 +23,27 @@ from ..core.dndarray import DNDarray
 __all__ = [
     "Module",
     "Linear",
+    "Conv2d",
+    "MaxPool2d",
+    "AvgPool2d",
+    "BatchNorm1d",
+    "BatchNorm2d",
+    "LayerNorm",
     "ReLU",
+    "LeakyReLU",
+    "GELU",
+    "ELU",
     "Tanh",
     "Sigmoid",
+    "Softmax",
     "LogSoftmax",
+    "Identity",
     "Flatten",
     "Dropout",
+    "Dropout2d",
     "Sequential",
     "MSELoss",
+    "L1Loss",
     "NLLLoss",
     "CrossEntropyLoss",
 ]
@@ -41,15 +54,54 @@ def _to_value(x):
 
 
 class Module:
-    """Base module: explicit-parameter pytrees + pure ``apply``."""
+    """Base module: explicit-parameter pytrees + pure ``apply``.
+
+    Two authoring styles, both jit/grad-safe:
+
+    - *leaf/container style*: override ``init``/``apply`` (see :class:`Linear`).
+    - *torch style* (the reference's UX — its examples subclass ``ht.nn.Module`` and
+      write an imperative ``forward``, ``examples/nn/mnist.py:23-45``): assign
+      submodules as attributes in ``__init__`` and override ``forward(x)``. The
+      default ``init`` collects attribute submodules in definition order; the default
+      ``apply`` binds the params pytree (and the PRNG/train context) onto the
+      submodules, then calls ``forward`` — inside which ``self.conv1(x)`` etc. route
+      through the bound tracers, keeping the whole thing a pure function of
+      ``(params, x)``.
+    """
+
+    def named_submodules(self) -> List[Tuple[str, "Module"]]:
+        """Attribute submodules in definition order (torch's registration order)."""
+        return [(k, v) for k, v in vars(self).items() if isinstance(v, Module)]
 
     def init(self, key: jax.Array) -> Any:
         """Create this module's parameter pytree."""
-        return ()
+        subs = self.named_submodules()
+        if not subs:
+            return ()
+        keys = jax.random.split(key, len(subs))
+        return {name: m.init(k) for (name, m), k in zip(subs, keys)}
+
+    def forward(self, x):
+        """Torch-style forward over bound submodules; override in subclasses."""
+        raise NotImplementedError()
 
     def apply(self, params: Any, x: jax.Array, *, key: Optional[jax.Array] = None, train: bool = False) -> jax.Array:
         """Pure forward pass."""
+        if type(self).forward is not Module.forward:
+            self._bind(params, key, train)
+            return _to_value(self.forward(x))
         raise NotImplementedError()
+
+    def _bind(self, params, key, train: bool) -> None:
+        subs = self.named_submodules()
+        keys = (
+            jax.random.split(key, max(len(subs), 1))
+            if key is not None
+            else [None] * len(subs)
+        )
+        for (name, m), k in zip(subs, keys):
+            m._params = params[name]
+            m._ctx = (k, train)
 
     # ------------------------------------------------------------- stateful veneer
     @property
@@ -68,7 +120,25 @@ class Module:
         (``nn/data_parallel.py:105-106``)."""
         self._params = self.init(jax.random.key(seed))
 
-    def __call__(self, x, *, key=None, train: bool = False):
+    def train(self, mode: bool = True) -> "Module":
+        """Set train/eval mode (torch semantics); affects Dropout/BatchNorm defaults."""
+        self._train_mode = mode
+        for _, m in self.named_submodules():
+            m.train(mode)
+        return self
+
+    def eval(self) -> "Module":
+        return self.train(False)
+
+    def __call__(self, x, *, key=None, train: Optional[bool] = None):
+        ctx = getattr(self, "_ctx", None)
+        if ctx is not None:
+            if key is None:
+                key = ctx[0]
+            if train is None:
+                train = ctx[1]
+        if train is None:
+            train = getattr(self, "_train_mode", False)
         value = self.apply(self.params, _to_value(x), key=key, train=train)
         if isinstance(x, DNDarray):
             from ..core._operations import wrap_result
@@ -104,9 +174,209 @@ class Linear(Module):
         return y
 
 
+class Conv2d(Module):
+    """2-D convolution, torch.nn.Conv2d semantics: input (N, C, H, W), weight
+    (out, in/groups, kH, kW), LeCun-style uniform init with bound 1/sqrt(fan_in)."""
+
+    def __init__(
+        self,
+        in_channels: int,
+        out_channels: int,
+        kernel_size,
+        stride=1,
+        padding=0,
+        dilation=1,
+        groups: int = 1,
+        bias: bool = True,
+    ):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.bias = bias
+
+    def init(self, key):
+        from . import functional as F
+
+        k1, k2 = jax.random.split(key)
+        kh, kw = self.kernel_size
+        fan_in = self.in_channels // self.groups * kh * kw
+        bound = 1.0 / np.sqrt(fan_in)
+        w = jax.random.uniform(
+            k1,
+            (self.out_channels, self.in_channels // self.groups, kh, kw),
+            jnp.float32,
+            -bound,
+            bound,
+        )
+        if not self.bias:
+            return {"weight": w}
+        b = jax.random.uniform(k2, (self.out_channels,), jnp.float32, -bound, bound)
+        return {"weight": w, "bias": b}
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.conv2d(
+            x,
+            params["weight"],
+            params.get("bias"),
+            stride=self.stride,
+            padding=self.padding,
+            dilation=self.dilation,
+            groups=self.groups,
+        )
+
+
+class MaxPool2d(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool2d(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
+
+
+class _BatchNorm(Module):
+    """Shared BatchNorm1d/2d machinery (torch semantics).
+
+    ``weight``/``bias`` are learnable params; running statistics are module buffers.
+    Training normalizes by batch statistics; eval by the stored running statistics.
+    The running buffers are updated only from *eager* (non-traced) calls — inside a
+    jitted step the statistics are traced values that cannot be written back to
+    Python state (jax arrays are immutable; torch's in-place buffer mutation has no
+    functional equivalent), so jitted training keeps using batch stats.
+    """
+
+    def __init__(self, num_features: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, track_running_stats: bool = True):
+        self.num_features = num_features
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.track_running_stats = track_running_stats
+        self.running_mean = jnp.zeros((num_features,), jnp.float32)
+        self.running_var = jnp.ones((num_features,), jnp.float32)
+
+    def init(self, key):
+        if not self.affine:
+            return ()
+        return {
+            "weight": jnp.ones((self.num_features,), jnp.float32),
+            "bias": jnp.zeros((self.num_features,), jnp.float32),
+        }
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        weight = params.get("weight") if self.affine else None
+        bias = params.get("bias") if self.affine else None
+        running = self.track_running_stats and not train
+        out, mean, var = F.batch_norm(
+            x,
+            self.running_mean if running else None,
+            self.running_var if running else None,
+            weight,
+            bias,
+            training=train or not self.track_running_stats,
+            eps=self.eps,
+        )
+        if train and self.track_running_stats and not isinstance(mean, jax.core.Tracer):
+            m = self.momentum
+            n = x.shape[0] * (x.size // (x.shape[0] * self.num_features))
+            unbias = n / max(n - 1, 1)
+            self.running_mean = (1 - m) * self.running_mean + m * mean
+            self.running_var = (1 - m) * self.running_var + m * var * unbias
+        return out
+
+
+class BatchNorm1d(_BatchNorm):
+    """torch.nn.BatchNorm1d over (N, C) or (N, C, L) inputs."""
+
+
+class BatchNorm2d(_BatchNorm):
+    """torch.nn.BatchNorm2d over (N, C, H, W) inputs."""
+
+
+class LayerNorm(Module):
+    def __init__(self, normalized_shape, eps: float = 1e-5, elementwise_affine: bool = True):
+        self.normalized_shape = (
+            (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+        )
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+
+    def init(self, key):
+        if not self.elementwise_affine:
+            return ()
+        return {
+            "weight": jnp.ones(self.normalized_shape, jnp.float32),
+            "bias": jnp.zeros(self.normalized_shape, jnp.float32),
+        }
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        weight = params.get("weight") if self.elementwise_affine else None
+        bias = params.get("bias") if self.elementwise_affine else None
+        return F.layer_norm(x, self.normalized_shape, weight, bias, self.eps)
+
+
 class ReLU(Module):
     def apply(self, params, x, *, key=None, train=False):
         return jnp.maximum(x, 0.0)
+
+
+class LeakyReLU(Module):
+    def __init__(self, negative_slope: float = 0.01):
+        self.negative_slope = negative_slope
+
+    def apply(self, params, x, *, key=None, train=False):
+        return jax.nn.leaky_relu(x, self.negative_slope)
+
+
+class GELU(Module):
+    def apply(self, params, x, *, key=None, train=False):
+        return jax.nn.gelu(x)
+
+
+class ELU(Module):
+    def __init__(self, alpha: float = 1.0):
+        self.alpha = alpha
+
+    def apply(self, params, x, *, key=None, train=False):
+        return jax.nn.elu(x, self.alpha)
+
+
+class Softmax(Module):
+    def __init__(self, dim: int = -1):
+        self.dim = dim
+
+    def apply(self, params, x, *, key=None, train=False):
+        return jax.nn.softmax(x, axis=self.dim)
+
+
+class Identity(Module):
+    def apply(self, params, x, *, key=None, train=False):
+        return x
 
 
 class Tanh(Module):
@@ -145,11 +415,28 @@ class Dropout(Module):
         return jnp.where(keep, x / (1.0 - self.p), 0.0)
 
 
+class Dropout2d(Module):
+    """Channel dropout (torch.nn.Dropout2d): zeroes whole feature maps."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        if not train or self.p == 0.0:
+            return x
+        return F.dropout2d(x, self.p, training=True, key=key)
+
+
 class Sequential(Module):
     """Chained modules (torch.nn.Sequential semantics)."""
 
     def __init__(self, *layers: Module):
         self.layers = list(layers)
+
+    def named_submodules(self):
+        return [(str(i), m) for i, m in enumerate(self.layers)]
 
     def init(self, key):
         keys = jax.random.split(key, max(len(self.layers), 1))
@@ -175,6 +462,14 @@ class MSELoss:
     def __call__(self, pred, target):
         p, t = _to_value(pred), _to_value(target)
         return jnp.mean((p - t) ** 2)
+
+
+class L1Loss:
+    """Mean absolute error (torch.nn.L1Loss semantics)."""
+
+    def __call__(self, pred, target):
+        p, t = _to_value(pred), _to_value(target)
+        return jnp.mean(jnp.abs(p - t))
 
 
 class NLLLoss:

@@ -59,16 +59,34 @@ class DataParallelOptimizer:
             raise TypeError(f"blocking parameter must be a boolean, currently {type(blocking)}")
         if not _HAS_OPTAX:
             raise RuntimeError("optax is required for DataParallelOptimizer")
+        # string specs go through inject_hyperparams so the learning rate lives in
+        # the optimizer *state* — host-side lr_scheduler writes take effect on the
+        # next jitted step without re-compilation
         if torch_optimizer is None or torch_optimizer == "sgd":
-            torch_optimizer = optax.sgd(lr)
+            torch_optimizer = optax.inject_hyperparams(optax.sgd)(learning_rate=lr)
         elif torch_optimizer == "adam":
-            torch_optimizer = optax.adam(lr)
+            torch_optimizer = optax.inject_hyperparams(optax.adam)(learning_rate=lr)
         self.local_optimizer = torch_optimizer
         self.torch_optimizer = torch_optimizer  # parity alias
         self.blocking_parameter_updates = blocking
+        self._lr = float(lr)
         self._model = None
         self._opt_state = None
         self._step_fns = {}
+
+    @property
+    def lr(self) -> float:
+        """Current learning rate (mutable; consumed by heat_tpu.optim.lr_scheduler)."""
+        return self._lr
+
+    @lr.setter
+    def lr(self, value: float) -> None:
+        self._lr = float(value)
+        state = self._opt_state
+        if state is not None and hasattr(state, "hyperparams"):
+            state.hyperparams["learning_rate"] = jnp.asarray(
+                self._lr, state.hyperparams["learning_rate"].dtype
+            )
 
     def _attach(self, model) -> None:
         self._model = model
@@ -246,6 +264,19 @@ class DASO:
     @property
     def n_nodes(self) -> int:
         return getattr(self.comm, "n_nodes", 1)
+
+    @property
+    def lr(self) -> float:
+        """Learning rate of the underlying local optimizer (scheduler-mutable)."""
+        return self.local_optimizer.lr
+
+    @lr.setter
+    def lr(self, value: float) -> None:
+        self.local_optimizer.lr = value
+        state = self._stacked_opt_state
+        if state is not None and hasattr(state, "hyperparams"):
+            cur = state.hyperparams["learning_rate"]
+            state.hyperparams["learning_rate"] = jnp.full_like(cur, float(value))
 
     def _node_spec(self, extra_dims: int):
         """PartitionSpec for a replica-stacked leaf: leading dim over the slow axis."""
