@@ -6,14 +6,14 @@ semantic version triple.
 
 major: int = 0
 """Major version number."""
-minor: int = 1
+minor: int = 2
 """Minor version number."""
 micro: int = 0
 """Micro (patch) version number."""
-extension: str = "dev"
-"""Pre-release tag."""
+extension: str = "dev0"
+"""Pre-release tag (PEP 440 suffix, e.g. ``dev0``; empty for releases)."""
 
 if not extension:
     __version__ = f"{major}.{minor}.{micro}"
 else:
-    __version__ = f"{major}.{minor}.{micro}-{extension}"
+    __version__ = f"{major}.{minor}.{micro}.{extension}"
