@@ -1,0 +1,31 @@
+# Sphinx configuration for heat_tpu (reference doc/source/conf.py).
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "heat_tpu"
+author = "heat_tpu contributors"
+release = "0.2.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.autosummary",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+    "sphinx.ext.intersphinx",
+]
+
+autosummary_generate = True
+napoleon_google_docstring = True
+napoleon_numpy_docstring = True
+
+intersphinx_mapping = {
+    "python": ("https://docs.python.org/3", None),
+    "numpy": ("https://numpy.org/doc/stable/", None),
+    "jax": ("https://docs.jax.dev/en/latest/", None),
+}
+
+templates_path = ["_templates"]
+exclude_patterns = []
+html_theme = "alabaster"

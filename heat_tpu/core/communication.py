@@ -235,8 +235,18 @@ class MeshCommunication(Communication):
         a jitted ``with_sharding_constraint``, which GSPMD supports via internal padding.
         """
         target = self.sharding(array.ndim, split)
-        if array.sharding == target:
+        if isinstance(array, jax.Array) and array.sharding == target:
             return array
+        if jax.process_count() > 1:
+            # multi-controller: a host value can only populate addressable shards —
+            # build per-shard via callback (each process fills only its own devices);
+            # an existing global array reshard compiles to the XLA collective.
+            if isinstance(array, jax.Array) and not array.is_fully_addressable:
+                return _ragged_reshard(array, target)
+            np_value = np.asarray(array)
+            return jax.make_array_from_callback(
+                np_value.shape, target, lambda idx: np_value[idx]
+            )
         if split is None or array.shape[split] % self.size == 0:
             return jax.device_put(array, target)
         return _ragged_reshard(array, target)
@@ -379,6 +389,17 @@ def sanitize_comm(comm: Optional[Communication]) -> MeshCommunication:
 def initialize(**kwargs) -> None:
     """Multi-host bootstrap: ``jax.distributed.initialize`` replaces the mpirun launcher
     (reference launches via ``mpirun -np N python script.py``, ``scripts/heat_test.py:1-9``).
+
+    Multi-controller contract (every process runs the same program, SPMD):
+
+    - compute on DNDarrays is global — XLA emits the cross-host collectives; nothing
+      special to do;
+    - collection (``numpy()``/``tolist()``/``item()``/printing) performs a cross-host
+      ``process_allgather`` and returns the identical global value on every process;
+    - ``ht.save*`` gathers and writes from process 0 only (see ``io._is_writer``);
+      ``ht.load*`` reads the file on every process (shared filesystem assumed, like
+      the reference's MPI-IO setups) and populates only addressable shards;
+    - per-process ingest of pre-distributed data uses ``ht.array(..., is_split=k)``.
     """
     jax.distributed.initialize(**kwargs)
     global COMM_WORLD, __default_comm

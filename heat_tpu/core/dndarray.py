@@ -368,11 +368,22 @@ class DNDarray:
         """The single element as a Python scalar (reference ``dndarray.py:1144``)."""
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
+        if not self.__array.is_fully_addressable:
+            return self.numpy().reshape(()).item()
         return self.__array.reshape(()).item()
 
     def numpy(self) -> np.ndarray:
-        """Gather into a numpy array (reference ``dndarray.py:1169``)."""
-        return np.asarray(self.__array)
+        """Gather into a numpy array (reference ``dndarray.py:1169``).
+
+        Multi-controller contract: when this process does not address every shard
+        (``jax.process_count() > 1``), the value is fetched with a cross-host
+        ``process_allgather`` so every controller returns the same global array —
+        the TPU form of the reference's rank-0 gather + Bcast."""
+        if self.__array.is_fully_addressable:
+            return np.asarray(self.__array)
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(self.__array, tiled=True))
 
     def tolist(self, keepsplit: bool = False) -> list:
         """Nested Python lists (reference ``dndarray.py:1861``)."""

@@ -136,9 +136,39 @@ def array(
     if is_split is not None:
         is_split = sanitize_axis(value.shape, is_split)
         if jax.process_count() > 1:
-            raise NotImplementedError(
-                "multi-controller is_split ingest requires jax.make_array_from_single_device_arrays"
+            # each process declared its own pre-distributed chunk: infer the global
+            # shape by allgathering local shapes (reference factories.py:188) and
+            # assemble without moving data off-host
+            from jax.experimental import multihost_utils
+
+            comm_obj = sanitize_comm(comm)
+            np_value = np.asarray(value)
+            all_shapes = np.asarray(
+                multihost_utils.process_allgather(jnp.asarray(np.array(np_value.shape)))
+            ).reshape(jax.process_count(), np_value.ndim)
+            for d in range(np_value.ndim):
+                if d != is_split and not np.all(all_shapes[:, d] == np_value.shape[d]):
+                    raise ValueError(
+                        f"is_split chunks disagree on non-split dim {d}: {all_shapes[:, d]}"
+                    )
+            gshape = list(np_value.shape)
+            gshape[is_split] = int(all_shapes[:, is_split].sum())
+            # jax can only assemble process-local chunks that match the even canonical
+            # partition; the reference accepts arbitrary chunk sizes (factories.py:188)
+            # — reject the unrepresentable case loudly rather than mis-assemble
+            per_proc = gshape[is_split] // jax.process_count()
+            if gshape[is_split] % jax.process_count() != 0 or not np.all(
+                all_shapes[:, is_split] == per_proc
+            ):
+                raise NotImplementedError(
+                    f"multi-controller is_split needs equal per-process chunks "
+                    f"(got extents {all_shapes[:, is_split].tolist()}); pad or "
+                    f"rebalance the local chunks before ingest"
+                )
+            garr = jax.make_array_from_process_local_data(
+                comm_obj.sharding(np_value.ndim, is_split), np_value, tuple(gshape)
             )
+            return _wrap(garr, dtype, is_split, device, comm)
         return _wrap(value, dtype, is_split, device, comm)
     return _wrap(value, dtype, split, device, comm)
 

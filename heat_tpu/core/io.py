@@ -39,6 +39,44 @@ except ImportError:
     _HAS_NETCDF = False
 
 
+def _is_writer() -> bool:
+    """Multi-controller contract: process 0 is the single writer.
+
+    The reference writes per-rank hyperslabs through parallel HDF5/MPI-IO when
+    available and serializes otherwise (``io.py:46-49``). Plain h5py/netCDF4/numpy
+    writers cannot coordinate concurrent writes to one file, so under
+    ``jax.process_count() > 1`` every process gathers the global value (see
+    ``DNDarray.numpy``) and only process 0 touches the filesystem.
+    """
+    import jax
+
+    return jax.process_index() == 0
+
+
+def _sharded_read(data, gshape, np_dtype, split: int, comm):
+    """Per-shard hyperslab reads of an indexable file dataset (reference io.py:211-238).
+
+    Evenly divisible shapes go through ``jax.make_array_from_callback`` — it invokes the
+    callback once per *addressable* shard, so each process reads only its own slabs
+    straight into device buffers. Ragged shapes (which that API rejects) fall back to
+    slab-wise assembly in a host buffer + a padded GSPMD reshard.
+    """
+    import jax
+
+    if gshape[split] % comm.size == 0:
+        return jax.make_array_from_callback(
+            gshape,
+            comm.sharding(len(gshape), split),
+            lambda idx: np.asarray(data[idx], dtype=np_dtype),
+        )
+    arr = np.empty(gshape, dtype=np_dtype)
+    for r in range(comm.size):
+        _, lshape, slices = comm.chunk(gshape, split, rank=r)
+        if 0 not in lshape:
+            arr[slices] = data[slices]
+    return arr
+
+
 def supports_hdf5() -> bool:
     """True if HDF5 I/O is available (reference ``io.py:36``)."""
     return _HAS_HDF5
@@ -73,21 +111,17 @@ if _HAS_HDF5:
             raise ValueError(f"load_fraction must be in (0, 1], got {load_fraction}")
         comm = sanitize_comm(comm)
         dtype = types.canonical_heat_type(dtype)
+        np_dtype = np.dtype(dtype.jax_type())
         with h5py.File(path, "r") as handle:
             data = handle[dataset]
             gshape = tuple(data.shape)
             if load_fraction < 1.0 and split == 0:
                 gshape = (int(gshape[0] * load_fraction),) + gshape[1:]
             if split is None or comm.size == 1:
-                arr = np.asarray(data[tuple(slice(0, s) for s in gshape)], dtype=np.dtype(dtype.jax_type()))
-            else:
-                # read per-shard hyperslabs (reference io.py:211-238); single-controller
-                # reads all shards it addresses, multi-controller only its own
-                arr = np.empty(gshape, dtype=np.dtype(dtype.jax_type()))
-                for r in range(comm.size):
-                    _, _, slices = comm.chunk(gshape, split, rank=r)
-                    arr[slices] = data[slices]
-        return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+                arr = np.asarray(data[tuple(slice(0, s) for s in gshape)], dtype=np_dtype)
+                return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+            value = _sharded_read(data, gshape, np_dtype, split, comm)
+        return factories.array(value, dtype=dtype, split=split, device=device, comm=comm)
 
     def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
         """Save to an HDF5 dataset (reference ``io.py:167``): per-shard hyperslab
@@ -96,6 +130,13 @@ if _HAS_HDF5:
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
         if not isinstance(path, str):
             raise TypeError(f"path must be str, not {type(path)}")
+        if not data.larray.is_fully_addressable:
+            # multi-controller: gather, single writer (see _is_writer)
+            value = data.numpy()
+            if _is_writer():
+                with h5py.File(path, mode) as handle:
+                    handle.create_dataset(dataset, data=value, **kwargs)
+            return
         with h5py.File(path, mode) as handle:
             dset = handle.create_dataset(dataset, data.gshape, dtype=np.dtype(data.dtype.jax_type()), **kwargs)
             if data.split is None:
@@ -120,15 +161,24 @@ if _HAS_NETCDF:
         """Load a NetCDF variable (reference ``io.py:284``)."""
         comm = sanitize_comm(comm)
         dtype = types.canonical_heat_type(dtype)
+        np_dtype = np.dtype(dtype.jax_type())
         with nc.Dataset(path, "r") as handle:
             data = handle.variables[variable]
-            arr = np.asarray(data[...], dtype=np.dtype(dtype.jax_type()))
-        return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+            gshape = tuple(data.shape)
+            if split is None or comm.size == 1:
+                arr = np.asarray(data[...], dtype=np_dtype)
+                return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+            # per-shard hyperslab reads, same treatment as HDF5 (reference io.py:444)
+            value = _sharded_read(data, gshape, np_dtype, split, comm)
+        return factories.array(value, dtype=dtype, split=split, device=device, comm=comm)
 
     def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
         """Save to a NetCDF variable (reference ``io.py:367``)."""
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
+        value = data.numpy()
+        if not _is_writer():
+            return
         with nc.Dataset(path, mode) as handle:
             dims = []
             for i, s in enumerate(data.gshape):
@@ -136,7 +186,7 @@ if _HAS_NETCDF:
                 handle.createDimension(name, s)
                 dims.append(name)
             var = handle.createVariable(variable, np.dtype(data.dtype.jax_type()), tuple(dims))
-            var[...] = data.numpy()
+            var[...] = value
 
 
 def load_csv(
@@ -149,19 +199,71 @@ def load_csv(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """Load a CSV file (reference ``io.py:723``; the reference's byte-offset parallel
-    line parsing is host-side I/O — one mapped read covers all local shards here)."""
+    """Load a CSV file with byte-offset chunked parsing (reference ``io.py:723``).
+
+    A binary newline scan over an ``mmap`` of the file (no resident copy — the OS pages
+    the scan through) indexes the row offsets; each shard's rows are then located by
+    the canonical :meth:`Communication.chunk` rule and only that byte range is decoded
+    and parsed — parsing, the dominant cost, happens per-shard like the HDF5
+    hyperslab reads.
+    """
+    import mmap
+
     if not isinstance(path, str):
         raise TypeError(f"path must be str, not {type(path)}")
     if not isinstance(sep, str):
         raise TypeError(f"separator must be str, not {type(sep)}")
     if not isinstance(header_lines, int):
         raise TypeError(f"header_lines must be int, not {type(header_lines)}")
+    comm = sanitize_comm(comm)
     dtype = types.canonical_heat_type(dtype)
-    arr = np.genfromtxt(
-        path, delimiter=sep, skip_header=header_lines, dtype=np.dtype(dtype.jax_type()),
-        encoding=encoding,
-    )
+    np_dtype = np.dtype(dtype.jax_type())
+
+    # pass 1: index line start offsets (binary newline scan, no parsing)
+    with open(path, "rb") as fh:
+        try:
+            # POSIX: the mapping outlives the closed descriptor
+            blob = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError:  # empty file cannot be mmapped
+            blob = b""
+    offsets = [0]
+    pos = blob.find(b"\n")
+    while pos != -1:
+        offsets.append(pos + 1)
+        pos = blob.find(b"\n", pos + 1)
+    if offsets[-1] >= len(blob):  # trailing newline → no final partial row
+        offsets.pop()
+    offsets.append(len(blob))
+    # data rows: skip headers, drop blank lines anywhere (np.genfromtxt semantics)
+    row_starts, row_ends = [], []
+    for s, e in zip(offsets[header_lines:-1], offsets[header_lines + 1 :]):
+        if blob[s:e].strip():
+            row_starts.append(s)
+            row_ends.append(e)
+    nrows = len(row_starts)
+    if nrows == 0:
+        return factories.array(
+            np.empty((0,), dtype=np_dtype), dtype=dtype, split=split, device=device, comm=comm
+        )
+
+    def parse_rows(lo: int, hi: int) -> np.ndarray:
+        chunk = blob[row_starts[lo] : row_ends[hi - 1]].decode(encoding)
+        fields = [line.split(sep) for line in chunk.splitlines() if line.strip()]
+        return np.asarray(fields, dtype=np_dtype)
+
+    ncols = len(blob[row_starts[0] : row_ends[0]].decode(encoding).split(sep))
+    gshape: Tuple[int, ...] = (nrows,) if ncols == 1 else (nrows, ncols)
+
+    if split != 0 or comm.size == 1:
+        arr = parse_rows(0, nrows).reshape(gshape)
+    else:
+        # split=0: each shard parses only its own byte range (reference io.py:780-905)
+        arr = np.empty(gshape, dtype=np_dtype)
+        for r in range(comm.size):
+            _, lshape, slices = comm.chunk(gshape, 0, rank=r)
+            if lshape[0] > 0:
+                lo = slices[0].start
+                arr[slices] = parse_rows(lo, lo + lshape[0]).reshape(lshape)
     return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
 
 
@@ -180,6 +282,8 @@ def save_csv(
     if data.ndim > 2:
         raise ValueError("CSV can only store 1-D or 2-D arrays")
     arr = data.numpy()
+    if not _is_writer():
+        return
     if decimals >= 0:
         fmt = f"%.{decimals}f"
     elif np.issubdtype(arr.dtype, np.integer):
@@ -198,7 +302,9 @@ def load_npy(path: str, dtype=None, split: Optional[int] = None, device=None, co
 
 def save_npy(data: DNDarray, path: str) -> None:
     """Save to a .npy file."""
-    np.save(path, data.numpy())
+    arr = data.numpy()
+    if _is_writer():
+        np.save(path, arr)
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:

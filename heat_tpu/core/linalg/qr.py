@@ -36,15 +36,17 @@ def qr(
 ) -> Tuple[Optional[DNDarray], DNDarray]:
     """QR decomposition of a 2-D DNDarray; returns ``QR(Q, R)`` (reference ``qr.py:19``).
 
-    ``tiles_per_proc`` is accepted for API parity; the XLA build has no tile scheduler
-    to tune. split=0 uses TSQR (communication-optimal for tall-skinny — the reference's
-    CAQR collapses to two QR levels because the R-reduction is a single global op);
-    split=1/None lower to XLA's blocked householder QR.
+    ``tiles_per_proc`` keeps the reference's meaning — how many row panels each shard
+    contributes (reference builds a ``SquareDiagTiles`` with it, ``qr.py:130``): the
+    split=0 TSQR uses ``tiles.tile_rows`` panels, so larger values trade panel-QR size
+    for R-stack size. split=1/None lower to XLA's blocked householder QR.
     """
     if not isinstance(a, DNDarray):
         raise TypeError(f"'a' must be a DNDarray, got {type(a)}")
     if a.ndim != 2:
         raise ValueError(f"qr requires a 2-D array, got {a.ndim}-D")
+    if not isinstance(tiles_per_proc, int) or tiles_per_proc < 1:
+        raise ValueError(f"tiles_per_proc must be a positive int, got {tiles_per_proc}")
     if not types.issubdtype(a.dtype, types.floating):
         a = a.astype(types.promote_types(a.dtype, types.float32))
 
@@ -52,7 +54,13 @@ def qr(
     nproc = a.comm.size
 
     if a.split == 0 and a.is_distributed() and m >= n * nproc:
-        q_val, r_val = _tsqr(a.larray, nproc, calc_q=calc_q)
+        from ..tiling import SquareDiagTiles
+
+        # the reference's tile decomposition fixes the panel schedule (qr.py:130);
+        # every tile row is one TSQR level-1 panel
+        tiles = SquareDiagTiles(a, tiles_per_proc=tiles_per_proc)
+        nblocks = tiles.tile_rows if m >= n * tiles.tile_rows else nproc
+        q_val, r_val = _tsqr(a.larray, nblocks, calc_q=calc_q)
     elif calc_q:
         # split=1 / None / short-fat: XLA's QR on the global value (the reference's
         # split=1 path is a panel loop with Bcast, qr.py:866 — subsumed by SPMD)

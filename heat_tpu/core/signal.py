@@ -2,21 +2,59 @@
 
 The reference's distributed 1-D ``convolve`` pads, computes a halo size from the kernel's
 local shape, exchanges halos with neighbouring ranks (``signal.py:107-120``, via
-``DNDarray.get_halo``), and runs a local ``torch.conv1d`` per rank. On TPU the signal is
-one global sharded array: a single ``jnp.convolve`` computes the same thing and XLA emits
-the boundary collective-permutes the halo exchange hand-wrote.
+``DNDarray.get_halo``), and runs a local ``torch.conv1d`` per rank. The TPU form of that
+halo pipeline is :func:`_convolve_overlap_add`: every shard convolves its chunk locally
+and the (kernel-1)-wide boundary tail rides one ``ppermute`` hop to the next shard on
+the ICI ring — overlap-add, the collective-permute dual of the reference's halo
+exchange. Replicated or feature-split inputs fall back to one global ``jnp.convolve``.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec
 
 from . import types
 from .dndarray import DNDarray
 
 __all__ = ["convolve"]
+
+
+def _convolve_overlap_add(comm, av: jax.Array, vv: jax.Array, n: int, m: int) -> jax.Array:
+    """Distributed full convolution by overlap-add under ``shard_map``.
+
+    Shard ``i`` holds ``c = n_pad/P`` samples and computes a local full convolution
+    (length ``c+m-1``). The trailing ``m-1`` values overlap shard ``i+1``'s head: they
+    are sent one hop down the ring (reference halo Isend/Irecv, ``dndarray.py:387-455``)
+    and added. The global result is the shards' bodies back-to-back plus the last
+    shard's tail — total length ``n+m-1`` after unpadding.
+    """
+    axis = comm.axis_name
+    nproc = comm.size
+    c = -(-n // nproc)
+    n_pad = c * nproc
+    if n_pad != n:
+        av = jnp.pad(av, (0, n_pad - n))
+    av = comm.shard(av, 0)
+
+    def body(al, vl):
+        y = jnp.convolve(al.reshape(-1), vl.reshape(-1), mode="full")  # c+m-1
+        tail = y[c:]  # my halo into the next shard's head
+        recv = jax.lax.ppermute(tail, axis, [(i, i + 1) for i in range(nproc - 1)])
+        out = y[:c].at[: m - 1].add(recv)
+        return out, tail
+
+    out, tails = jax.shard_map(
+        body,
+        mesh=comm.mesh,
+        in_specs=(PartitionSpec(axis), PartitionSpec()),
+        out_specs=(PartitionSpec(axis), PartitionSpec(axis)),
+    )(av, vv)
+    # bodies cover [0, n_pad); the final m-1 values come from the last shard's tail
+    return jnp.concatenate([out, tails[-(m - 1) :]])[: n + m - 1]
 
 
 def convolve(a, v, mode: str = "full") -> DNDarray:
@@ -36,9 +74,21 @@ def convolve(a, v, mode: str = "full") -> DNDarray:
     if a.gshape[0] < v.gshape[0]:
         a, v = v, a
     dt = types.promote_types(a.dtype, v.dtype)
-    result = jnp.convolve(
-        a.larray.astype(dt.jax_type()), v.larray.astype(dt.jax_type()), mode=mode
-    )
+    av = a.larray.astype(dt.jax_type())
+    vv = v.larray.astype(dt.jax_type())
+    n, m = a.gshape[0], v.gshape[0]
+    if a.split == 0 and a.is_distributed() and m >= 2 and m - 1 <= -(-n // a.comm.size):
+        # distributed signal: explicit halo/overlap-add schedule on the ring
+        full = _convolve_overlap_add(a.comm, av, vv, n, m)
+    else:
+        full = jnp.convolve(av, vv, mode="full")
+    if mode == "full":
+        result = full
+    elif mode == "same":
+        off = (m - 1) // 2
+        result = full[off : off + n]
+    else:  # valid
+        result = full[m - 1 : n]
     split = a.split
     out = a.comm.shard(result, split)
     return DNDarray(

@@ -1,6 +1,15 @@
 """Packaged sample datasets (reference heat/datasets/: iris/diabetes files used by
-tests and demos). Files here are synthesized deterministically by :func:`generate` at
-build/test time rather than shipped as binary blobs."""
+tests and demos across csv/h5/nc, plus train/test split files). Files here are
+synthesized deterministically by :func:`generate` at build/test time rather than
+shipped as binary blobs — same shapes and roles as the reference's files, fresh values.
+
+Reference inventory mirrored (heat/datasets/):
+- ``iris.csv/.h5/.nc``            → ``flowers.csv/.h5/.nc`` (150×4, 3 classes)
+- ``iris_X_train/X_test.csv``     → ``flowers_X_train/X_test.csv`` (120/30 × 4)
+- ``iris_y_train/y_test.csv``     → ``flowers_y_train/y_test.csv``
+- ``iris_labels.csv``             → ``flowers_labels.csv`` (one label per sample)
+- ``diabetes.h5``                 → ``sugar.h5`` (442×10 regression table)
+"""
 
 import os
 
@@ -17,19 +26,55 @@ def path(name: str) -> str:
     return p
 
 
-def generate() -> None:
-    """Create the sample data files: a 150x4 'flowers' table (iris-shaped: three
-    100-per-class gaussian clusters) as CSV and HDF5."""
-    rng = np.random.default_rng(20260729)
-    blocks = []
-    for center in ((5.0, 3.4, 1.5, 0.2), (5.9, 2.8, 4.3, 1.3), (6.6, 3.0, 5.6, 2.0)):
+def _flowers(rng) -> tuple:
+    blocks, labels = [], []
+    for k, center in enumerate(
+        ((5.0, 3.4, 1.5, 0.2), (5.9, 2.8, 4.3, 1.3), (6.6, 3.0, 5.6, 2.0))
+    ):
         blocks.append(rng.normal(center, 0.3, size=(50, 4)))
-    data = np.vstack(blocks).astype(np.float32)
+        labels.append(np.full(50, k, dtype=np.int64))
+    return np.vstack(blocks).astype(np.float32), np.concatenate(labels)
+
+
+def generate() -> None:
+    """Create the sample data files (see module docstring for the inventory)."""
+    rng = np.random.default_rng(20260729)
+    data, labels = _flowers(rng)
     np.savetxt(os.path.join(_DIR, "flowers.csv"), data, delimiter=";", fmt="%.4f")
+    np.savetxt(os.path.join(_DIR, "flowers_labels.csv"), labels, fmt="%d")
+
+    # deterministic stratified 80/20 split (reference ships fixed split files)
+    perm = rng.permutation(150)
+    train, test = perm[:120], perm[120:]
+    np.savetxt(os.path.join(_DIR, "flowers_X_train.csv"), data[train], delimiter=";", fmt="%.4f")
+    np.savetxt(os.path.join(_DIR, "flowers_X_test.csv"), data[test], delimiter=";", fmt="%.4f")
+    np.savetxt(os.path.join(_DIR, "flowers_y_train.csv"), labels[train], fmt="%d")
+    np.savetxt(os.path.join(_DIR, "flowers_y_test.csv"), labels[test], fmt="%d")
+
+    # regression table shaped like the reference's diabetes.h5 (442×10 + target)
+    n, d = 442, 10
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal(d).astype(np.float32)
+    y = (X @ w + 0.1 * rng.standard_normal(n)).astype(np.float32)
+
     try:
         import h5py
 
         with h5py.File(os.path.join(_DIR, "flowers.h5"), "w") as f:
             f.create_dataset("data", data=data)
+        with h5py.File(os.path.join(_DIR, "sugar.h5"), "w") as f:
+            f.create_dataset("x", data=X)
+            f.create_dataset("y", data=y)
+    except ImportError:
+        pass
+
+    try:
+        import netCDF4 as nc
+
+        with nc.Dataset(os.path.join(_DIR, "flowers.nc"), "w") as f:
+            f.createDimension("samples", data.shape[0])
+            f.createDimension("features", data.shape[1])
+            var = f.createVariable("data", np.float32, ("samples", "features"))
+            var[...] = data
     except ImportError:
         pass

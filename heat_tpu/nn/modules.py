@@ -148,7 +148,8 @@ class Module:
 
 
 class Linear(Module):
-    """Affine layer y = x W + b (torch.nn.Linear semantics, He-uniform init)."""
+    """Affine layer y = x W + b (torch.nn.Linear semantics, torch's default
+    LeCun-style uniform init with bound 1/sqrt(in_features))."""
 
     def __init__(self, in_features: int, out_features: int, bias: bool = True):
         self.in_features = in_features

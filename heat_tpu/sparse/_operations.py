@@ -38,9 +38,9 @@ def binary_op_csr(operation: Callable, t1: DCSR_matrix, t2) -> DCSR_matrix:
         pos2 = np.searchsorted(union, k2)
         np.add.at(a, pos1, v1)  # duplicate indices accumulate, like sum_duplicates
         np.add.at(b, pos2, v2)
+        # keep the full union pattern, explicit zeros included — torch/scipy CSR
+        # union semantics (the reference never prunes result zeros)
         vals = np.asarray(operation(jnp.asarray(a), jnp.asarray(b)))
-        keep = vals != 0
-        union, vals = union[keep], vals[keep]
         idx = np.stack([union // ncols, union % ncols], axis=1)
         bcoo = jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)), shape=t1.shape)
     elif np.isscalar(t2):

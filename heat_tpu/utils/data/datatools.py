@@ -50,8 +50,10 @@ class Dataset:
 class DataLoader:
     """Minibatch iterator over a Dataset or DNDarray (reference ``datatools.py:16``).
 
-    Yields batches as DNDarrays (split preserved). ``drop_last`` defaults to True so
-    every batch has identical shape — one compiled program per step, no re-tracing.
+    Yields batches as DNDarrays (split preserved). ``drop_last`` defaults to False like
+    torch's DataLoader (reference ``datatools.py:16``); the ragged tail batch costs one
+    extra XLA trace per distinct shape — pass ``drop_last=True`` for a single compiled
+    step program.
     """
 
     def __init__(
@@ -61,7 +63,7 @@ class DataLoader:
         num_workers: int = 0,
         collate_fn=None,
         pin_memory: bool = False,
-        drop_last: bool = True,
+        drop_last: bool = False,
         timeout: float = 0,
         worker_init_fn=None,
         lcl_dataset=None,

@@ -49,8 +49,29 @@ class PartialH5Dataset:
         self.transforms = transforms
         self.load_length = int(load_length)
         self.initial_load = int(initial_load)
+        # use_gpu is accepted for reference-API parity; device placement is decided by
+        # jax (jnp.asarray lands on the default device), so there is nothing to toggle.
+        self.use_gpu = use_gpu
+        self.validate_set = validate_set
         with h5py.File(file, "r") as f:
-            self.total_size = f[self.dataset_names[0]].shape[0]
+            dset = f[self.dataset_names[0]]
+            self.total_size = dset.shape[0]
+            if available_memory is not None:
+                # size windows so one resident window fits the stated budget
+                # (reference sizes its local window from available_memory, :66-83)
+                per_sample = int(
+                    sum(
+                        np.dtype(f[name].dtype).itemsize * int(np.prod(f[name].shape[1:], dtype=np.int64))
+                        for name in self.dataset_names
+                    )
+                )
+                fit = max(1, int(available_memory) // max(1, per_sample))
+                self.load_length = min(self.load_length, fit)
+                self.initial_load = min(self.initial_load, fit)
+        if self.validate_set:
+            # validation sets are read once in full, no windowing (reference :120-131)
+            self.initial_load = self.total_size
+            self.load_length = self.total_size
 
     def __len__(self) -> int:
         return self.total_size
@@ -60,9 +81,15 @@ class PartialH5Dataset:
         import h5py
 
         with h5py.File(self.file, "r") as f:
-            for lo in range(start, stop, self.load_length):
-                hi = min(lo + self.load_length, stop)
+            # first window is initial_load samples, steady state load_length
+            # (reference :85-118)
+            lo = start
+            width = self.initial_load
+            while lo < stop:
+                hi = min(lo + width, stop)
                 out_queue.put({name: np.asarray(f[name][lo:hi]) for name in self.dataset_names})
+                lo = hi
+                width = self.load_length
         out_queue.put(None)
 
     def __iter__(self) -> "PartialH5DataLoaderIter":

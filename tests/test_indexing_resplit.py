@@ -1,0 +1,240 @@
+"""Distribution-verb and indexing parity sweeps (reference
+heat/core/tests/test_dndarray.py:828-1086 coverage area and the split-sweep pattern of
+test_suites/basic_test.py:138-299).
+
+- resplit matrix: every (from, to) split pair × even/uneven/smaller-than-mesh sizes
+- advanced indexing: get/set with fancy indices, bool masks, mixed keys — every split,
+  verified element-wise against numpy on the same fixture
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestResplitMatrix(TestCase):
+    def test_all_pairs_2d(self):
+        """Every (from, to) ∈ {None,0,1}² on even, uneven, and tiny shapes."""
+        for shape in ((8, 8), (7, 5), (3, 2), (1, 9)):
+            np_x = np.arange(int(np.prod(shape))).reshape(shape).astype(np.float32)
+            for src in (None, 0, 1):
+                for dst in (None, 0, 1):
+                    x = ht.array(np_x, split=src)
+                    y = x.resplit(dst)
+                    self.assertEqual(y.split, dst, f"shape={shape} {src}->{dst}")
+                    self.assert_array_equal(y, np_x)
+                    # in-place variant
+                    x.resplit_(dst)
+                    self.assertEqual(x.split, dst)
+                    self.assert_array_equal(x, np_x)
+
+    def test_all_pairs_3d(self):
+        shape = (4, 5, 3)
+        np_x = np.arange(60).reshape(shape).astype(np.float32)
+        for src in (None, 0, 1, 2):
+            for dst in (None, 0, 1, 2):
+                x = ht.array(np_x, split=src)
+                y = ht.resplit(x, dst)
+                self.assertEqual(y.split, dst)
+                self.assert_array_equal(y, np_x)
+
+    def test_resplit_preserves_dtype(self):
+        for dt in (ht.int32, ht.float64, ht.bool):
+            x = ht.ones((6, 4), dtype=dt, split=0)
+            y = x.resplit(1)
+            self.assertIs(y.dtype, dt)
+
+    def test_redistribute_and_balance(self):
+        np_x = np.arange(22).reshape(11, 2).astype(np.float32)
+        x = ht.array(np_x, split=0)
+        x.balance_()
+        self.assertTrue(x.is_balanced())
+        self.assert_array_equal(x, np_x)
+
+
+class TestGetitemParity(TestCase):
+    """Element-wise getitem parity vs numpy for every split."""
+
+    def _sweep(self, np_x, keys):
+        for split in (None,) + tuple(range(np_x.ndim)):
+            x = ht.array(np_x, split=split)
+            for key in keys:
+                expected = np_x[key]
+                got = x[key]
+                np.testing.assert_array_equal(
+                    got.numpy(), expected, err_msg=f"split={split} key={key!r}"
+                )
+                self.assertEqual(got.gshape, expected.shape)
+
+    def test_basic_2d(self):
+        np_x = np.arange(63).reshape(9, 7)
+        self._sweep(
+            np_x,
+            [
+                (2,),
+                (-1,),
+                (slice(1, 6),),
+                (slice(None, None, 2),),
+                (slice(8, 2, -2),),
+                (2, 3),
+                (slice(1, 5), slice(2, 6)),
+                (Ellipsis, 2),
+                (slice(None), -1),
+                (None, slice(None)),  # newaxis
+                (slice(2, 4), None, slice(1, 3)),
+            ],
+        )
+
+    def test_fancy_2d(self):
+        np_x = np.arange(63).reshape(9, 7)
+        idx = np.array([0, 4, 2, 8])
+        cols = np.array([1, 1, 6, 0])
+        self._sweep(
+            np_x,
+            [
+                (idx,),
+                (idx, cols),  # paired point selection
+                (idx, slice(1, 5)),  # fancy × slice
+                (slice(None), cols),  # slice × fancy
+                (np.array([[0, 1], [2, 3]]),),  # 2-D fancy index
+                ([3, 1],),  # plain-list fancy
+            ],
+        )
+
+    def test_bool_masks_2d(self):
+        np_x = np.arange(63).reshape(9, 7)
+        full_mask = np_x % 3 == 0
+        row_mask = np_x[:, 0] > 20
+        self._sweep(
+            np_x,
+            [
+                (full_mask,),
+                (row_mask,),  # 1-D mask over rows
+                (row_mask, slice(2, 5)),
+            ],
+        )
+
+    def test_dndarray_keys(self):
+        np_x = np.arange(40).reshape(8, 5)
+        for split in (None, 0, 1):
+            x = ht.array(np_x, split=split)
+            # DNDarray int index vector, itself distributed
+            hidx = ht.array(np.array([1, 7, 3]), split=0)
+            np.testing.assert_array_equal(x[hidx].numpy(), np_x[[1, 7, 3]])
+            # DNDarray bool mask (matching shape)
+            hmask = x > 17
+            np.testing.assert_array_equal(x[hmask].numpy(), np_x[np_x > 17])
+
+    def test_3d(self):
+        np_x = np.arange(120).reshape(4, 6, 5)
+        self._sweep(
+            np_x,
+            [
+                (1,),
+                (slice(None), 3),
+                (Ellipsis, 2),
+                (slice(1, 3), slice(None), slice(0, 4, 2)),
+                (np.array([2, 0]),),
+                (slice(None), np.array([1, 4]), slice(None)),
+                (1, slice(2, 5), np.array([0, 3])),
+            ],
+        )
+
+    def test_split_bookkeeping(self):
+        np_x = np.arange(63).reshape(9, 7)
+        x0 = ht.array(np_x, split=0)
+        x1 = ht.array(np_x, split=1)
+        # slice keeps the split on the surviving dim
+        self.assertEqual(x0[1:5].split, 0)
+        self.assertEqual(x1[1:5].split, 1)
+        self.assertEqual(x1[1:5, 2:4].split, 1)
+        # integer eats dim 0: split1 becomes dim 0 of the result
+        self.assertEqual(x1[2].split, 0)
+        self.assertEqual(x0[2].split, None)
+        # fancy index consumed the split axis
+        self.assertEqual(x0[np.array([1, 2])].split, None)
+
+
+class TestSetitemParity(TestCase):
+    def _sweep(self, shape, ops):
+        for split in (None,) + tuple(range(len(shape))):
+            np_x = np.arange(int(np.prod(shape))).reshape(shape).astype(np.float32)
+            x = ht.array(np_x, split=split)
+            for key, value in ops:
+                x[key] = value
+                np_x[key] = value.numpy() if isinstance(value, ht.DNDarray) else value
+            np.testing.assert_array_equal(x.numpy(), np_x, err_msg=f"split={split}")
+            self.assertEqual(x.split, split)
+
+    def test_basic(self):
+        self._sweep(
+            (6, 5),
+            [
+                ((2, 3), 99.0),
+                ((slice(0, 2),), -1.0),
+                ((slice(None), 4), 7.0),
+                ((slice(1, 4), slice(1, 3)), np.full((3, 2), 5.0, np.float32)),
+                ((-1,), np.arange(5, dtype=np.float32)),
+            ],
+        )
+
+    def test_fancy_and_masks(self):
+        self._sweep(
+            (6, 5),
+            [
+                ((np.array([0, 3]),), 42.0),
+                ((np.array([1, 2]), np.array([0, 4])), 13.0),
+                ((np.array([5, 4]), slice(1, 3)), np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)),
+            ],
+        )
+        # boolean full mask
+        for split in (None, 0, 1):
+            np_x = np.arange(30).reshape(6, 5).astype(np.float32)
+            x = ht.array(np_x, split=split)
+            mask = np_x > 12
+            x[mask] = 0.0
+            np_x[mask] = 0.0
+            np.testing.assert_array_equal(x.numpy(), np_x)
+
+    def test_dndarray_keys(self):
+        for split in (None, 0, 1):
+            np_x = np.arange(30).reshape(6, 5).astype(np.float32)
+            x = ht.array(np_x.copy(), split=split)
+            x[x > 12] = -1.0
+            ref = np_x.copy()
+            ref[np_x > 12] = -1.0
+            np.testing.assert_array_equal(x.numpy(), ref)
+            x2 = ht.array(np_x.copy(), split=split)
+            x2[ht.array(np.array([0, 3]), split=0)] = 7.0
+            ref2 = np_x.copy()
+            ref2[[0, 3]] = 7.0
+            np.testing.assert_array_equal(x2.numpy(), ref2)
+
+    def test_dndarray_value(self):
+        for split in (None, 0, 1):
+            np_x = np.zeros((6, 5), np.float32)
+            x = ht.array(np_x, split=split)
+            v = ht.arange(5, dtype=ht.float32, split=0)
+            x[2] = v
+            np_x[2] = np.arange(5)
+            np.testing.assert_array_equal(x.numpy(), np_x)
+            # differently-split 2-D value
+            v2 = ht.ones((2, 5), split=1)
+            x[3:5] = v2
+            np_x[3:5] = 1.0
+            np.testing.assert_array_equal(x.numpy(), np_x)
+
+    def test_broadcast_value(self):
+        for split in (None, 0, 1):
+            np_x = np.zeros((4, 6), np.float32)
+            x = ht.array(np_x, split=split)
+            x[1:3] = np.arange(6, dtype=np.float32)  # broadcast row
+            np_x[1:3] = np.arange(6)
+            np.testing.assert_array_equal(x.numpy(), np_x)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

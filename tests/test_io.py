@@ -24,6 +24,40 @@ class TestIO(TestCase):
             np.testing.assert_allclose(back.numpy(), self.data, rtol=1e-5)
             self.assertEqual(back.split, split)
 
+    def test_csv_byte_offset_parse(self):
+        """The chunked parser agrees with a whole-file parse for every split, uneven
+        row counts, 1-column files, and missing trailing newline (reference io.py:723)."""
+        rng = np.random.default_rng(3)
+        for nrows in (7, 16, 3):  # uneven, even, fewer-rows-than-devices
+            data = rng.random((nrows, 4)).astype(np.float32)
+            p = os.path.join(self.tmp, f"b{nrows}.csv")
+            np.savetxt(p, data, delimiter=",")
+            for split in (None, 0, 1):
+                back = ht.load_csv(p, split=split)
+                np.testing.assert_allclose(back.numpy(), data, rtol=1e-6)
+                self.assertEqual(back.split, split)
+        # single column → 1-D result, like np.genfromtxt
+        p = os.path.join(self.tmp, "col.csv")
+        np.savetxt(p, np.arange(9.0))
+        back = ht.load_csv(p, split=0)
+        self.assertEqual(back.gshape, (9,))
+        # no trailing newline
+        p = os.path.join(self.tmp, "tail.csv")
+        with open(p, "w") as fh:
+            fh.write("1,2\n3,4\n5,6")
+        back = ht.load_csv(p, split=0)
+        np.testing.assert_allclose(back.numpy(), [[1, 2], [3, 4], [5, 6]])
+        # interior blank lines are skipped (np.genfromtxt semantics)
+        p = os.path.join(self.tmp, "blank.csv")
+        with open(p, "w") as fh:
+            fh.write("1,2\n\n3,4\n   \n5,6\n")
+        back = ht.load_csv(p, split=0)
+        np.testing.assert_allclose(back.numpy(), [[1, 2], [3, 4], [5, 6]])
+        # empty file
+        p = os.path.join(self.tmp, "empty.csv")
+        open(p, "w").close()
+        self.assertEqual(ht.load_csv(p).gshape, (0,))
+
     def test_csv_header(self):
         p = os.path.join(self.tmp, "h.csv")
         ht.save_csv(ht.array(self.data), p, header_lines=["a,b,c,d,e"], decimals=5)
@@ -39,6 +73,19 @@ class TestIO(TestCase):
             ht.save(x, p, "data")
             back = ht.load(p, dataset="data", split=split)
             np.testing.assert_allclose(back.numpy(), self.data, rtol=1e-6)
+            self.assertEqual(back.split, split)
+
+    def test_hdf5_divisible_callback_path(self):
+        """Evenly divisible shapes ride jax.make_array_from_callback (per-addressable
+        -shard slab reads); ragged shapes ride the host-assembly fallback."""
+        if not ht.io.supports_hdf5():
+            self.skipTest("h5py not available")
+        data = np.arange(self.world_size * 4 * 6, dtype=np.float32).reshape(-1, 6)
+        p = os.path.join(self.tmp, "div.h5")
+        ht.save_hdf5(ht.array(data), p, "data")
+        for split in (0, 1):
+            back = ht.load_hdf5(p, "data", split=split)
+            np.testing.assert_allclose(back.numpy(), data, rtol=1e-6)
             self.assertEqual(back.split, split)
 
     def test_hdf5_load_fraction(self):
@@ -78,6 +125,28 @@ class TestIO(TestCase):
         if ht.io.supports_hdf5():
             h = ht.load(datasets.path("flowers.h5"), dataset="data", split=0)
             np.testing.assert_allclose(h.numpy(), x.numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_packaged_dataset_splits(self):
+        """Train/test split files and the regression table (reference ships
+        iris_X_train/... and diabetes.h5)."""
+        from heat_tpu import datasets
+
+        xtr = ht.load_csv(datasets.path("flowers_X_train.csv"), sep=";", split=0)
+        xte = ht.load_csv(datasets.path("flowers_X_test.csv"), sep=";", split=0)
+        ytr = ht.load_csv(datasets.path("flowers_y_train.csv"), dtype=ht.int64, split=0)
+        yte = ht.load_csv(datasets.path("flowers_y_test.csv"), dtype=ht.int64, split=0)
+        self.assertEqual(tuple(xtr.shape), (120, 4))
+        self.assertEqual(tuple(xte.shape), (30, 4))
+        self.assertEqual(tuple(ytr.shape), (120,))
+        self.assertEqual(tuple(yte.shape), (30,))
+        labels = ht.load_csv(datasets.path("flowers_labels.csv"), dtype=ht.int64)
+        self.assertEqual(tuple(labels.shape), (150,))
+        self.assertEqual(set(np.unique(labels.numpy())), {0, 1, 2})
+        if ht.io.supports_hdf5():
+            sx = ht.load(datasets.path("sugar.h5"), dataset="x", split=0)
+            sy = ht.load(datasets.path("sugar.h5"), dataset="y", split=0)
+            self.assertEqual(tuple(sx.shape), (442, 10))
+            self.assertEqual(tuple(sy.shape), (442,))
 
 
 if __name__ == "__main__":

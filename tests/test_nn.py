@@ -119,6 +119,22 @@ class TestDataParallelTraining(TestCase):
             ht.optim.DataParallelOptimizer(blocking="yes")
 
 
+class TestMNISTExample(TestCase):
+    def test_cnn_gate(self):
+        """The reference's own conv net (examples/nn/mnist.py:26-43) must train to
+        >95% on the gate subset."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples", "nn"))
+        try:
+            import mnist as mnist_example
+        finally:
+            sys.path.pop(0)
+        acc = mnist_example.main(["--epochs", "3", "--batch-size", "128", "--n", "512"])
+        self.assertGreater(acc, 0.95)
+
+
 class TestDASO(TestCase):
     def _setup(self, total_epochs=10, warmup=2, cooldown=2):
         model = ht.nn.Sequential(ht.nn.Linear(2, 4), ht.nn.ReLU(), ht.nn.Linear(4, 2))
@@ -187,10 +203,15 @@ class TestDataTools(TestCase):
 
     def test_dataloader_batches(self):
         x = ht.arange(24, split=0).reshape((12, 2))
+        # torch-parity default: keep the ragged tail batch
         loader = ht.utils.data.DataLoader(x, batch_size=5)
         batches = list(loader)
-        self.assertEqual(len(batches), 2)  # drop_last
+        self.assertEqual(len(batches), 3)
         self.assertEqual(tuple(batches[0].shape), (5, 2))
+        self.assertEqual(tuple(batches[-1].shape), (2, 2))
+        loader = ht.utils.data.DataLoader(x, batch_size=5, drop_last=True)
+        batches = list(loader)
+        self.assertEqual(len(batches), 2)
         with self.assertRaises(TypeError):
             ht.utils.data.DataLoader(42)
 
@@ -203,10 +224,26 @@ class TestDataTools(TestCase):
         p = os.path.join(tempfile.mkdtemp(), "stream.h5")
         data = np.arange(100.0, dtype=np.float32).reshape(25, 4)
         ht.save_hdf5(ht.array(data), p, "data")
-        ds = ht.utils.data.partial_dataset.PartialH5Dataset(p, load_length=10)
+        ds = ht.utils.data.partial_dataset.PartialH5Dataset(p, initial_load=10, load_length=10)
         chunks = [np.asarray(c) for c in ds]
         self.assertEqual(len(chunks), 3)
         np.testing.assert_allclose(np.vstack(chunks), data)
+        # initial_load gives a larger first window (reference :85-118)
+        ds = ht.utils.data.partial_dataset.PartialH5Dataset(p, initial_load=15, load_length=5)
+        sizes = [len(np.asarray(c)) for c in ds]
+        self.assertEqual(sizes, [15, 5, 5])
+        # available_memory caps the window: 4 cols × 4 B = 16 B/sample → 5 samples
+        ds = ht.utils.data.partial_dataset.PartialH5Dataset(
+            p, initial_load=100, load_length=100, available_memory=80
+        )
+        sizes = [len(np.asarray(c)) for c in ds]
+        self.assertEqual(sizes, [5, 5, 5, 5, 5])
+        # validate_set reads the whole dataset in one window (reference :120-131)
+        ds = ht.utils.data.partial_dataset.PartialH5Dataset(
+            p, initial_load=5, load_length=5, validate_set=True
+        )
+        sizes = [len(np.asarray(c)) for c in ds]
+        self.assertEqual(sizes, [25])
 
 
 if __name__ == "__main__":

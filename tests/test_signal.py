@@ -38,6 +38,24 @@ class TestConvolve(TestCase):
         with self.assertRaises(ValueError):
             ht.convolve(ht.ones(10), ht.ones(3), mode="bogus")
 
+    def test_overlap_add_path(self):
+        """The shard_map halo/overlap-add schedule agrees with numpy for ragged
+        lengths, large-vs-chunk kernels (fallback), and every mode."""
+        rng = np.random.default_rng(7)
+        for n in (self.world_size * 8, self.world_size * 8 + 3, 65):
+            sig = rng.random(n).astype(np.float32)
+            for m in (2, 5, 9):
+                ker = rng.random(m).astype(np.float32)
+                a, v = ht.array(sig, split=0), ht.array(ker)
+                for mode in ("full", "valid") + (("same",) if m % 2 else ()):
+                    got = ht.convolve(a, v, mode=mode)
+                    expected = np.convolve(sig, ker, mode=mode)
+                    self.assertEqual(got.gshape, expected.shape)
+                    np.testing.assert_allclose(
+                        got.numpy(), expected, rtol=1e-5,
+                        err_msg=f"n={n} m={m} mode={mode}",
+                    )
+
     def test_int_promotion(self):
         a = np.arange(8)
         v = np.array([1, 2, 1])

@@ -1,0 +1,115 @@
+"""Tiling tests (reference heat/core/tests/test_tiling.py): tile grids must cover the
+matrix exactly, give numpy-identical views, and drive the QR panel schedule."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestSplitTiles(TestCase):
+    def test_grid_covers_array(self):
+        np_x = np.arange(11 * 6, dtype=np.float32).reshape(11, 6)
+        x = ht.array(np_x, split=0)
+        tiles = ht.tiling.SplitTiles(x)
+        dims = tiles.tile_dimensions
+        self.assertEqual(dims.shape, (2, self.world_size))
+        # extents along each axis sum to the global shape
+        self.assertEqual(int(dims[0].sum()), 11)
+        self.assertEqual(int(dims[1].sum()), 6)
+        np.testing.assert_array_equal(tiles.tile_ends_g[:, -1], [11, 6])
+
+    def test_views_match_numpy(self):
+        np_x = np.arange(12 * 8, dtype=np.float32).reshape(12, 8)
+        x = ht.array(np_x, split=0)
+        tiles = ht.tiling.SplitTiles(x)
+        ends_r = tiles.tile_ends_g[0]
+        ends_c = tiles.tile_ends_g[1]
+        for i in range(self.world_size):
+            r0 = 0 if i == 0 else int(ends_r[i - 1])
+            np.testing.assert_array_equal(np.asarray(tiles[i]), np_x[r0 : int(ends_r[i])])
+            for j in range(self.world_size):
+                c0 = 0 if j == 0 else int(ends_c[j - 1])
+                np.testing.assert_array_equal(
+                    np.asarray(tiles[i, j]), np_x[r0 : int(ends_r[i]), c0 : int(ends_c[j])]
+                )
+
+    def test_setitem(self):
+        np_x = np.zeros((8, 4), np.float32)
+        x = ht.array(np_x, split=0)
+        tiles = ht.tiling.SplitTiles(x)
+        block = np.asarray(tiles[0]).copy()
+        tiles[0] = np.full_like(block, 9.0)
+        self.assertTrue(np.all(np.asarray(tiles[0]) == 9.0))
+        np_x[: block.shape[0]] = 9.0
+        self.assert_array_equal(x, np_x)
+
+
+class TestSquareDiagTiles(TestCase):
+    def test_square_diagonal(self):
+        m = self.world_size * 6
+        np_x = np.arange(m * 4, dtype=np.float32).reshape(m, 4)
+        x = ht.array(np_x, split=0)
+        for tpp in (1, 2, 3):
+            tiles = ht.tiling.SquareDiagTiles(x, tiles_per_proc=tpp)
+            self.assertEqual(tiles.tile_rows, self.world_size * tpp)
+            # diagonal tiles are square until the columns run out
+            for t in range(min(tiles.tile_rows, tiles.tile_columns) - 1):
+                h, w = tiles.get_tile_size((t, t))
+                self.assertEqual(h, w, f"diag tile {t} not square (tpp={tpp})")
+            # row starts are sorted and start at 0
+            self.assertEqual(tiles.row_indices[0], 0)
+            self.assertEqual(sorted(tiles.row_indices), tiles.row_indices)
+
+    def test_get_set_tile(self):
+        np_x = np.arange(16 * 16, dtype=np.float32).reshape(16, 16)
+        x = ht.array(np_x, split=0)
+        tiles = ht.tiling.SquareDiagTiles(x, tiles_per_proc=1)
+        i, j = 0, 1
+        r0, c0 = tiles.row_indices[i], tiles.col_indices[j]
+        h, w = tiles.get_tile_size((i, j))
+        np.testing.assert_array_equal(np.asarray(tiles[i, j]), np_x[r0 : r0 + h, c0 : c0 + w])
+        tiles[i, j] = np.zeros((h, w), np.float32)
+        np_x[r0 : r0 + h, c0 : c0 + w] = 0.0
+        self.assert_array_equal(x, np_x)
+
+    def test_tile_map_ownership(self):
+        x = ht.zeros((self.world_size * 4, 8), split=0)
+        tiles = ht.tiling.SquareDiagTiles(x, tiles_per_proc=2)
+        tmap = tiles.tile_map
+        self.assertEqual(tmap.shape, (tiles.tile_rows, tiles.tile_columns))
+        # two consecutive tile rows per shard
+        for i in range(tiles.tile_rows):
+            self.assertTrue(np.all(tmap[i] == min(i // 2, self.world_size - 1)))
+
+    def test_errors(self):
+        with self.assertRaises(TypeError):
+            ht.tiling.SquareDiagTiles(np.zeros((4, 4)))
+        with self.assertRaises(ValueError):
+            ht.tiling.SquareDiagTiles(ht.zeros((2, 2, 2)))
+        with self.assertRaises(ValueError):
+            ht.tiling.SquareDiagTiles(ht.zeros((4, 4)), tiles_per_proc=0)
+
+
+class TestQRTiles(TestCase):
+    def test_qr_tiles_per_proc(self):
+        """tiles_per_proc changes the TSQR panel schedule, never the answer."""
+        rng = np.random.default_rng(1)
+        m = max(self.world_size * 24, 48)
+        np_x = rng.standard_normal((m, 6)).astype(np.float32)
+        x = ht.array(np_x, split=0)
+        for tpp in (1, 2, 4):
+            q, r = ht.linalg.qr(x, tiles_per_proc=tpp)
+            np.testing.assert_allclose(
+                (q @ r).numpy(), np_x, atol=1e-4, err_msg=f"tpp={tpp}"
+            )
+            qn = q.numpy()
+            np.testing.assert_allclose(qn.T @ qn, np.eye(qn.shape[1]), atol=1e-4)
+        with self.assertRaises(ValueError):
+            ht.linalg.qr(x, tiles_per_proc=0)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
