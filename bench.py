@@ -1,9 +1,10 @@
 """Headline benchmark: prints ONE JSON line.
 
-Covers three of the five north-star configs (BASELINE.md): distributed matmul
+Covers four of the five north-star configs (BASELINE.md): distributed matmul
 split-0 × split-1 (reference ``benchmarks/cb/linalg.py:44-56``), KMeans fit
-(``benchmarks/cb/cluster.py:24-32``, scaled to the 10M×64 north-star), and
-``hsvd_rank`` (``benchmarks/cb/linalg.py:29-40``). The reference publishes no absolute
+(``benchmarks/cb/cluster.py:24-32``, scaled to the 10M×64 north-star; rides the
+fused Pallas Lloyd kernel), ``hsvd_rank`` (``benchmarks/cb/linalg.py:29-40``), and
+the data-parallel MLP step (``examples/nn/mnist.py``). The reference publishes no absolute
 numbers in-tree (BASELINE.json ``published: {}``), so ``vs_baseline`` of the headline
 matmul reports achieved fraction of the chip's peak bf16 matmul throughput; the other
 metrics ride along in ``extra_metrics`` as wall-clock seconds.
@@ -79,6 +80,35 @@ def _bench_hsvd(ht, jax, jnp, on_tpu):
     return m, n, rank, best
 
 
+def _bench_dp_step(ht, jax, jnp, on_tpu):
+    """North-star #5: data-parallel MLP training step (reference examples/nn/mnist.py
+    wrapped in DataParallel; here one fused XLA program per step)."""
+    n, d, h, classes = (8192, 784, 256, 10) if on_tpu else (512, 64, 32, 4)
+    x = ht.array(jax.random.normal(jax.random.key(5), (n, d), jnp.float32), split=0)
+    y = ht.array(
+        jax.random.randint(jax.random.key(6), (n,), 0, classes, jnp.int32).astype(jnp.int64),
+        split=0,
+    )
+    model = ht.nn.Sequential(ht.nn.Linear(d, h), ht.nn.ReLU(), ht.nn.Linear(h, classes))
+    opt = ht.optim.DataParallelOptimizer("sgd", lr=0.05)
+    ht.nn.DataParallel(model, optimizer=opt)
+    crit = ht.nn.CrossEntropyLoss()
+
+    def loss_fn(params, xb, yb):
+        return crit(model.apply(params, xb), yb)
+
+    opt.step(loss_fn, x, y)  # compile + warmup
+    iters = 20
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = opt.step(loss_fn, x, y)
+        float(loss)  # sync
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return n, d, h, best
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -90,6 +120,7 @@ def main():
     n, dtype_name, tflops = _bench_matmul(ht, jax, jnp, on_tpu)
     kn, kd, kk, kmeans_s = _bench_kmeans(ht, jax, jnp, on_tpu)
     hm, hn, hrank, hsvd_s = _bench_hsvd(ht, jax, jnp, on_tpu)
+    dn, dd, dh, dp_s = _bench_dp_step(ht, jax, jnp, on_tpu)
 
     # peak bf16 matmul throughput per chip: v5e ≈ 394 TFLOP/s (v5p ≈ 459); CPU: no target
     peak = 394.0 if on_tpu else max(tflops, 1e-9)
@@ -110,6 +141,11 @@ def main():
                         "metric": f"hsvd_rank_{hm}x{hn}_r{hrank}_split1",
                         "value": round(hsvd_s, 3),
                         "unit": "s",
+                    },
+                    {
+                        "metric": f"dp_mlp_step_{dn}x{dd}_h{dh}_split0",
+                        "value": round(dp_s * 1e3, 3),
+                        "unit": "ms",
                     },
                 ],
             }

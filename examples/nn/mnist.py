@@ -106,6 +106,7 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     x, y = get_data(n=args.n)
+    ht.random.seed(1234)  # deterministic shuffles regardless of ambient RNG state
     # held-out test split (80/20)
     n_train = (x.gshape[0] * 4) // 5
     x_train, y_train = x[:n_train], y[:n_train]

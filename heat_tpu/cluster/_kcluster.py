@@ -121,6 +121,13 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         per-estimator ``_update_centroids``, as a pure function of local values)."""
         raise NotImplementedError()
 
+    def _fused_step(self, x: DNDarray):
+        """Optional fused assignment+update (Pallas) for the Lloyd body.
+
+        Returns ``fn(xv, centers) -> (labels, sums, counts, sse)`` or ``None`` to use
+        the generic jnp body. Subclasses override where a kernel exists (KMeans)."""
+        return None
+
     def fit(self, x: DNDarray):
         """Shared Lloyd iteration (reference duplicates this across
         kmeans.py:105/kmedians.py:101/kmedoids.py:118): assign, update, converge when
@@ -138,7 +145,7 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         promoted = ht.promote_types(x.dtype, ht.float32).jax_type()
         xv = x.larray.astype(promoted)
         centers0 = self._cluster_centers.larray.astype(promoted)
-        n_iter, centers, labels, inertia = self._lloyd_fn()(xv, centers0)
+        n_iter, centers, labels, inertia = self._lloyd_fn(x)(xv, centers0)
         self._n_iter = int(n_iter)
         self._cluster_centers = ht.array(
             centers.astype(centers0.dtype), comm=x.comm
@@ -149,16 +156,26 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         self._inertia = float(inertia)
         return self
 
-    def _lloyd_fn(self):
+    def _lloyd_fn(self, x: DNDarray):
         """The jitted whole-fit Lloyd program, cached per
-        (estimator class, k, max_iter, tol, metric) so repeated fits hit XLA's
+        (estimator class, k, max_iter, tol, metric, fused?) so repeated fits hit XLA's
         compilation cache instead of re-tracing a fresh closure every call."""
+        fused = self._fused_step(x) if x.split in (None, 0) else None
+        # the fused closure bakes in the comm's mesh/axis (shard_map variant), so the
+        # cache key must carry that configuration, not just "fused or not"
+        if fused is None:
+            fused_kind = None
+        elif x.split is None or x.comm.size == 1:
+            fused_kind = "plain"
+        else:
+            fused_kind = ("sharded", x.comm.mesh, x.comm.axis_name)
         key = (
             type(self),
             self.n_clusters,
             self.max_iter,
             float(self.tol),
             self._metric_kind,
+            fused_kind,
         )
         fn = _LLOYD_CACHE.get(key)
         if fn is not None:
@@ -181,18 +198,31 @@ class _KCluster(ClusteringMixin, BaseEstimator):
 
             def body(state):
                 i, centers, _ = state
-                d = _pairwise(xv, centers, metric_kind)
-                labels = jnp.argmin(d, axis=1)
-                new = update(xv, labels, centers)
+                if fused is not None:
+                    # one streaming pass: distances, argmin, and the segment sums
+                    # never leave VMEM (core/kernels/kmeans.py)
+                    _, sums, counts, _ = fused(xv, centers)
+                    new = jnp.where(
+                        counts[:, None] > 0,
+                        (sums / jnp.maximum(counts[:, None], 1.0)).astype(centers.dtype),
+                        centers,
+                    )
+                else:
+                    d = _pairwise(xv, centers, metric_kind)
+                    labels = jnp.argmin(d, axis=1)
+                    new = update(xv, labels, centers)
                 shift = jnp.sum((centers - new) ** 2)
                 return i + 1, new, shift
 
             i, centers, _ = lax.while_loop(
                 cond, body, (jnp.int32(0), centers0, jnp.array(jnp.inf, centers0.dtype))
             )
-            d = _pairwise(xv, centers, metric_kind)
-            labels = jnp.argmin(d, axis=1)
-            inertia = jnp.sum(jnp.min(d, axis=1) ** 2)
+            if fused is not None:
+                labels, _, _, inertia = fused(xv, centers)
+            else:
+                d = _pairwise(xv, centers, metric_kind)
+                labels = jnp.argmin(d, axis=1)
+                inertia = jnp.sum(jnp.min(d, axis=1) ** 2)
             return i, centers, labels, inertia
 
         _LLOYD_CACHE[key] = lloyd

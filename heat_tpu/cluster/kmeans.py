@@ -50,3 +50,45 @@ class KMeans(_KCluster):
         # keep old center for empty clusters
         return jnp.where(counts[:, None] > 0, new, old)
 
+    def _fused_step(self, x):
+        """Pallas streaming assignment+update on TPU (core/kernels/kmeans.py): one
+        HBM pass over x per Lloyd iteration instead of three. Sharded point sets run
+        the kernel per shard under ``shard_map`` with a psum of the (k, d) partials —
+        the same single collective the jnp path's segment-sum emits."""
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return None
+        from ..core.kernels import fused_assign_update
+
+        comm = x.comm
+        if comm.size == 1 or x.split is None:
+            return fused_assign_update
+
+        axis = comm.axis_name
+        if not isinstance(axis, str):  # hierarchical meshes: keep the generic path
+            return None
+        if x.gshape[0] % comm.size != 0:
+            return None  # ragged shards: generic path
+
+        from jax.sharding import PartitionSpec as P
+
+        def sharded(xv, centers):
+            def body(xl, c):
+                labels, sums, counts, sse = fused_assign_update(xl, c)
+                return (
+                    labels,
+                    jax.lax.psum(sums, axis),
+                    jax.lax.psum(counts, axis),
+                    jax.lax.psum(sse, axis),
+                )
+
+            return jax.shard_map(
+                body,
+                mesh=comm.mesh,
+                in_specs=(P(axis, None), P()),
+                out_specs=(P(axis), P(), P(), P()),
+            )(xv, centers)
+
+        return sharded
+

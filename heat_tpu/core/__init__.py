@@ -24,6 +24,8 @@ from .signal import *
 from .tiling import *
 from .base import *
 from .io import *
+from .checkpoint import *
+from . import checkpoint
 from . import io
 from . import random
 from . import linalg

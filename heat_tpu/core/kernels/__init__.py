@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for hot ops where fusion beyond XLA's defaults pays.
+
+Each kernel ships with a pure-jnp reference implementation used (a) as the fallback on
+non-TPU backends and (b) by the tests to validate the kernel in interpreter mode.
+"""
+
+from .kmeans import fused_assign_update, fused_assign_update_reference
+
+__all__ = ["fused_assign_update", "fused_assign_update_reference"]

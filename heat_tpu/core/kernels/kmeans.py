@@ -1,0 +1,152 @@
+"""Fused KMeans assignment + centroid-accumulate Pallas kernel.
+
+The jnp Lloyd body materialises the (n, k) distance matrix in HBM, reads it back for
+the argmin, then reads x again for the segment-sum update — three HBM passes over
+O(n·k + n·d) bytes per iteration. This kernel streams x through VMEM once per
+iteration: each (BN, d) block computes its distance tile on the MXU, takes the argmin,
+and accumulates the per-cluster sums/counts and the min-distance² total in VMEM/SMEM
+accumulators. HBM traffic per iteration drops to one read of x plus O(k·d) outputs —
+the op becomes memory-bound at the streaming rate of x.
+
+Reference workload: KMeans 10M×64 (north-star #3, reference heat/cluster/kmeans.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_assign_update", "fused_assign_update_reference"]
+
+
+def fused_assign_update_reference(
+    xv: jax.Array, centers: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Pure-jnp reference: (labels, sums, counts, sse) of nearest-centroid assignment."""
+    xx = jnp.sum(xv * xv, axis=1, keepdims=True)
+    cc = jnp.sum(centers * centers, axis=1)[None, :]
+    d2 = xx + cc - 2.0 * jnp.matmul(xv, centers.T, precision=jax.lax.Precision.HIGHEST)
+    d2 = jnp.maximum(d2, 0.0)
+    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
+    k = centers.shape[0]
+    sums = jnp.zeros_like(centers).at[labels].add(xv)
+    counts = jnp.zeros((k,), xv.dtype).at[labels].add(1.0)
+    sse = jnp.sum(jnp.min(d2, axis=1))
+    return labels, sums, counts, sse
+
+
+def _kernel(nvalid_ref, x_ref, c_ref, labels_ref, sums_ref, counts_ref, sse_ref):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+    bn = x_ref.shape[0]
+    k = c_ref.shape[0]
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        sse_ref[0, 0] = jnp.float32(0.0)
+
+    x = x_ref[:]  # (BN, d)
+    c = c_ref[:]  # (k, d)
+    xx = jnp.sum(x * x, axis=1, keepdims=True)  # (BN, 1)
+    cc = jnp.sum(c * c, axis=1, keepdims=True).T  # (1, k)
+    # (BN, k) distance tile on the MXU. The quadratic expansion cancels
+    # catastrophically for near points, so the cross term needs full input
+    # precision (same rationale as spatial._pairwise).
+    xc = jax.lax.dot_general(
+        x,
+        c,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    d2 = jnp.maximum(xx + cc - 2.0 * xc, 0.0)
+    # explicit int32 argmin (Mosaic's reduce-index only lowers int32; the framework
+    # runs with x64 enabled): first index attaining the row minimum, numpy tie rule.
+    # Everything stays 2-D — Mosaic relayouts of 1-D vectors are restricted.
+    col = jax.lax.broadcasted_iota(jnp.int32, (bn, k), 1)
+    mind = jnp.min(d2, axis=1, keepdims=True)  # (BN, 1)
+    labels = jnp.min(
+        jnp.where(d2 == mind, col, jnp.int32(k)), axis=1, keepdims=True
+    )  # (BN, 1)
+    labels_ref[:] = labels.reshape(-1)  # 1-D block: lane-dim-only tiling constraint
+
+    rows = i * bn + jax.lax.broadcasted_iota(jnp.int32, (bn, 1), 0)
+    valid = rows < nvalid_ref[0]  # (BN, 1)
+    onehot = jnp.where(
+        jnp.logical_and(labels == col, valid), jnp.float32(1.0), jnp.float32(0.0)
+    )  # (BN, k)
+    # per-cluster partial sums: (k, BN) @ (BN, d) on the MXU; full input precision —
+    # bf16-rounded x would put ~0.5% noise on every accumulated coordinate
+    sums_ref[:] += jax.lax.dot_general(
+        onehot,
+        x,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
+    sse_ref[0, 0] += jnp.sum(jnp.where(valid, mind, 0.0))
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def _fused_pallas(xv, centers, block_n: int = 1024, interpret: bool = False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    # the framework enables x64 globally; Mosaic only legalizes i32 scalars, so the
+    # kernel (all-i32/f32 by construction) is traced with x64 off
+    with jax.enable_x64(False):
+        return _fused_pallas_body(xv, centers, pl, pltpu, block_n, interpret)
+
+
+def _fused_pallas_body(xv, centers, pl, pltpu, block_n: int, interpret: bool):
+    n, d = xv.shape
+    k = centers.shape[0]
+    bn = min(block_n, max(128, -(-n // 128) * 128))
+    n_pad = -(-n // bn) * bn
+    if n_pad != n:
+        xv = jnp.pad(xv, ((0, n_pad - n), (0, 0)))
+    grid = n_pad // bn
+
+    labels, sums, counts, sse = pl.pallas_call(
+        _kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # nvalid scalar
+            pl.BlockSpec((bn, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+            jax.ShapeDtypeStruct((k, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray([n], jnp.int32), xv.astype(jnp.float32), centers.astype(jnp.float32))
+    return labels[:n], sums, counts[0], sse[0, 0]
+
+
+def fused_assign_update(
+    xv: jax.Array, centers: jax.Array, interpret: bool = False
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """(labels, sums, counts, sse) in one streaming pass over ``xv``.
+
+    Uses the Pallas TPU kernel on TPU backends (or ``interpret=True`` anywhere);
+    falls back to the jnp reference otherwise.
+    """
+    if not interpret and jax.default_backend() != "tpu":
+        return fused_assign_update_reference(xv, centers)
+    return _fused_pallas(xv, centers, interpret=interpret)

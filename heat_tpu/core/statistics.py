@@ -253,10 +253,10 @@ def maximum(x1, x2, out=None) -> DNDarray:
     return _operations.binary_op(jnp.maximum, x1, x2, out)
 
 
-def mean(x: DNDarray, axis=None) -> DNDarray:
+def mean(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
     """Arithmetic mean (reference ``statistics.py:893``; the pairwise moment-merging
     Allreduce collapses into one global jnp.mean)."""
-    return _operations.reduce_op(jnp.mean, x, axis, None, False)
+    return _operations.reduce_op(jnp.mean, x, axis, None, keepdims)
 
 
 def median(x: DNDarray, axis: Optional[int] = None, keepdims: bool = False) -> DNDarray:

@@ -333,6 +333,14 @@ class DASO:
         (reference step state machine ``:747-832``)."""
         if loss_fn is None:
             raise TypeError("step() requires loss_fn(params, *batch)")
+        if self.n_nodes == 1:
+            # a single node group has nothing to diverge from or sync with — DASO
+            # degenerates to plain data-parallel (reference behaves identically with
+            # one MPI group); also sidesteps partitioning the degenerate
+            # one-replica-stacked program
+            loss = self.local_optimizer.step(loss_fn, *batch)
+            self._batch_in_epoch += 1
+            return loss
         if self._stacked_params is None:
             self._materialize()
         values = tuple(_to_value(b) for b in batch)

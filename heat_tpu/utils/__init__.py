@@ -1,3 +1,3 @@
 """Utility subpackage (reference heat/utils/)."""
 
-from . import data
+from . import data, vision_transforms

@@ -1,0 +1,261 @@
+"""Image transforms (reference heat/utils/vision_transforms.py: a 19-line passthrough
+to ``torchvision.transforms``).
+
+torchvision cannot execute on TPU, so the common transforms are provided natively as
+jnp ops over channel-first values — HW images, CHW images, or NCHW batches (the
+torchvision layout). Each transform is a callable object
+usable alone or inside :class:`Compose` — the torchvision calling convention the
+reference's examples rely on. Random transforms take an optional ``key``; without one
+they derive a fresh key from a module-level seed sequence (call :func:`seed` for
+reproducibility).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Compose",
+    "ToTensor",
+    "Normalize",
+    "RandomHorizontalFlip",
+    "RandomVerticalFlip",
+    "RandomCrop",
+    "CenterCrop",
+    "Resize",
+    "Lambda",
+    "seed",
+]
+
+_state = {"key": jax.random.key(0)}
+
+
+def seed(value: int) -> None:
+    """Seed the stream used by random transforms called without an explicit key."""
+    _state["key"] = jax.random.key(value)
+
+
+def _next_key(key: Optional[jax.Array]) -> jax.Array:
+    if key is not None:
+        return key
+    _state["key"], sub = jax.random.split(_state["key"])
+    return sub
+
+
+def _unwrap(x):
+    from ..core.dndarray import DNDarray
+
+    return (x.larray, x) if isinstance(x, DNDarray) else (jnp.asarray(x), None)
+
+
+def _rewrap(value, proto):
+    if proto is None:
+        return value
+    from ..core.dndarray import DNDarray
+    from ..core import types
+
+    split = proto.split if proto.split == 0 else None
+    return DNDarray(
+        proto.comm.shard(value, split), tuple(value.shape),
+        types.canonical_heat_type(value.dtype), split, proto.device, proto.comm, True,
+    )
+
+
+def _spatial_axes(ndim: int) -> Tuple[int, int]:
+    """(H, W) axes for 2-D images, 3-D CHW, or 4-D NCHW values."""
+    if ndim == 2:
+        return 0, 1
+    if ndim == 3:
+        return 1, 2
+    if ndim == 4:
+        return 2, 3
+    raise ValueError(f"expected a 2-D/3-D/4-D image value, got {ndim}-D")
+
+
+def _accepts_key(transform) -> bool:
+    import inspect
+
+    try:
+        return "key" in inspect.signature(transform).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+class Compose:
+    """Chain transforms (torchvision.transforms.Compose semantics)."""
+
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+        # signature-dispatched once: random transforms take `key=`, deterministic
+        # ones don't (never try/except — a transform's own TypeError must surface)
+        self._takes_key = [_accepts_key(t) for t in self.transforms]
+
+    def __call__(self, x, key: Optional[jax.Array] = None):
+        keys = (
+            jax.random.split(key, len(self.transforms))
+            if key is not None
+            else [None] * len(self.transforms)
+        )
+        for t, k, takes_key in zip(self.transforms, keys, self._takes_key):
+            x = t(x, key=k) if takes_key else t(x)
+        return x
+
+    def __repr__(self) -> str:
+        inner = ", ".join(repr(t) for t in self.transforms)
+        return f"Compose([{inner}])"
+
+
+class ToTensor:
+    """uint8 [0, 255] → float32 [0, 1] (torchvision.ToTensor without the HWC→CHW move,
+    which only exists because PIL is HWC; arrays here keep their layout)."""
+
+    def __call__(self, x):
+        v, proto = _unwrap(x)
+        if jnp.issubdtype(v.dtype, jnp.integer):
+            v = v.astype(jnp.float32) / 255.0
+        else:
+            v = v.astype(jnp.float32)
+        return _rewrap(v, proto)
+
+    def __repr__(self) -> str:
+        return "ToTensor()"
+
+
+class Normalize:
+    """Channel-wise (x - mean) / std; channel dim is the last-but-two for ≥3-D values
+    (CHW / NCHW), matching torchvision."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+
+    def __call__(self, x):
+        v, proto = _unwrap(x)
+        if v.ndim < 3:
+            mean, std = self.mean, self.std
+        else:
+            shape = (-1,) + (1, 1)
+            mean = self.mean.reshape(shape)
+            std = self.std.reshape(shape)
+        return _rewrap((v - mean) / std, proto)
+
+    def __repr__(self) -> str:
+        return f"Normalize(mean={self.mean.tolist()}, std={self.std.tolist()})"
+
+
+class RandomHorizontalFlip:
+    """Flip along W with probability p — per-sample for batched (4-D) input."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def __call__(self, x, key: Optional[jax.Array] = None):
+        v, proto = _unwrap(x)
+        k = _next_key(key)
+        _, w_ax = _spatial_axes(v.ndim)
+        if v.ndim == 4:
+            flip = jax.random.bernoulli(k, self.p, (v.shape[0],) + (1,) * 3)
+            return _rewrap(jnp.where(flip, jnp.flip(v, axis=w_ax), v), proto)
+        do = jax.random.bernoulli(k, self.p)
+        return _rewrap(jnp.where(do, jnp.flip(v, axis=w_ax), v), proto)
+
+    def __repr__(self) -> str:
+        return f"RandomHorizontalFlip(p={self.p})"
+
+
+class RandomVerticalFlip(RandomHorizontalFlip):
+    def __call__(self, x, key: Optional[jax.Array] = None):
+        v, proto = _unwrap(x)
+        k = _next_key(key)
+        h_ax, _ = _spatial_axes(v.ndim)
+        if v.ndim == 4:
+            flip = jax.random.bernoulli(k, self.p, (v.shape[0],) + (1,) * 3)
+            return _rewrap(jnp.where(flip, jnp.flip(v, axis=h_ax), v), proto)
+        do = jax.random.bernoulli(k, self.p)
+        return _rewrap(jnp.where(do, jnp.flip(v, axis=h_ax), v), proto)
+
+    def __repr__(self) -> str:
+        return f"RandomVerticalFlip(p={self.p})"
+
+
+class RandomCrop:
+    """Crop to ``size`` at a uniform offset (same offset for all samples of a batch —
+    one XLA dynamic-slice; per-sample offsets would forbid a single gather)."""
+
+    def __init__(self, size: Union[int, Tuple[int, int]], padding: int = 0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = int(padding)
+
+    def __call__(self, x, key: Optional[jax.Array] = None):
+        v, proto = _unwrap(x)
+        k = _next_key(key)
+        h_ax, w_ax = _spatial_axes(v.ndim)
+        if self.padding:
+            pads = [(0, 0)] * v.ndim
+            pads[h_ax] = pads[w_ax] = (self.padding, self.padding)
+            v = jnp.pad(v, pads)
+        th, tw = self.size
+        kh, kw = jax.random.split(k)
+        oh = jax.random.randint(kh, (), 0, v.shape[h_ax] - th + 1)
+        ow = jax.random.randint(kw, (), 0, v.shape[w_ax] - tw + 1)
+        starts = [0] * v.ndim
+        sizes = list(v.shape)
+        starts[h_ax], starts[w_ax] = oh, ow
+        sizes[h_ax], sizes[w_ax] = th, tw
+        return _rewrap(jax.lax.dynamic_slice(v, starts, sizes), proto)
+
+    def __repr__(self) -> str:
+        return f"RandomCrop(size={self.size}, padding={self.padding})"
+
+
+class CenterCrop:
+    def __init__(self, size: Union[int, Tuple[int, int]]):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        v, proto = _unwrap(x)
+        h_ax, w_ax = _spatial_axes(v.ndim)
+        th, tw = self.size
+        oh = (v.shape[h_ax] - th) // 2
+        ow = (v.shape[w_ax] - tw) // 2
+        idx = [slice(None)] * v.ndim
+        idx[h_ax] = slice(oh, oh + th)
+        idx[w_ax] = slice(ow, ow + tw)
+        return _rewrap(v[tuple(idx)], proto)
+
+    def __repr__(self) -> str:
+        return f"CenterCrop(size={self.size})"
+
+
+class Resize:
+    """Bilinear resize of the spatial dims (torchvision.Resize with a (h, w) size)."""
+
+    def __init__(self, size: Union[int, Tuple[int, int]]):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        v, proto = _unwrap(x)
+        h_ax, w_ax = _spatial_axes(v.ndim)
+        shape = list(v.shape)
+        shape[h_ax], shape[w_ax] = self.size
+        out = jax.image.resize(v.astype(jnp.float32), shape, method="bilinear")
+        return _rewrap(out.astype(v.dtype) if jnp.issubdtype(v.dtype, jnp.floating) else out, proto)
+
+    def __repr__(self) -> str:
+        return f"Resize(size={self.size})"
+
+
+class Lambda:
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, x):
+        return self.fn(x)
+
+    def __repr__(self) -> str:
+        return f"Lambda({getattr(self.fn, '__name__', 'fn')})"

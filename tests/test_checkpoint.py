@@ -1,0 +1,107 @@
+"""Checkpoint/resume tests (SURVEY §5: the reference has only data-level I/O; this is
+the training-state checkpointing the TPU build adds via orbax/tensorstore)."""
+
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestCheckpoint(TestCase):
+    def setUp(self):
+        self.tmp = tempfile.mkdtemp()
+
+    def tearDown(self):
+        shutil.rmtree(self.tmp, ignore_errors=True)
+
+    def test_roundtrip_mixed_tree(self):
+        x = ht.arange(24, dtype=ht.float32, split=0).reshape((6, 4))
+        w = ht.array(np.ones((4, 2), np.float32))
+        tree = {"x": x, "w": w, "step": np.int64(7)}
+        ht.save_checkpoint(tree, os.path.join(self.tmp, "ckpt"))
+        zeros = {"x": ht.zeros((6, 4), split=0), "w": ht.zeros((4, 2)), "step": np.int64(0)}
+        back = ht.load_checkpoint(zeros, os.path.join(self.tmp, "ckpt"))
+        self.assert_array_equal(back["x"], x.numpy())
+        self.assertEqual(back["x"].split, 0)
+        self.assertIsNone(back["w"].split)
+        self.assertEqual(int(back["step"]), 7)
+
+    def test_split_metadata_restored(self):
+        for split in (None, 0, 1):
+            y = ht.array(np.arange(20, dtype=np.float32).reshape(4, 5), split=split)
+            p = os.path.join(self.tmp, f"s{split}")
+            ht.save_checkpoint({"y": y}, p)
+            back = ht.load_checkpoint({"y": ht.zeros((4, 5), split=split)}, p)
+            self.assertEqual(back["y"].split, split)
+            self.assert_array_equal(back["y"], y.numpy())
+
+    def test_template_split_wins(self):
+        """The restore template decides the target split (the documented contract):
+        an array saved replicated restores row-split when the template says so."""
+        y = ht.array(np.arange(20, dtype=np.float32).reshape(4, 5), split=None)
+        p = os.path.join(self.tmp, "tmpl")
+        ht.save_checkpoint({"y": y}, p)
+        back = ht.load_checkpoint({"y": ht.zeros((4, 5), split=0)}, p)
+        self.assertEqual(back["y"].split, 0)
+        self.assert_array_equal(back["y"], y.numpy())
+
+    def test_manager_retention_and_latest(self):
+        x = ht.arange(12, dtype=ht.float32, split=0)
+        mgr = ht.CheckpointManager(os.path.join(self.tmp, "run"), max_to_keep=2)
+        for s in (1, 2, 3):
+            mgr.save(s, {"x": x * float(s)})
+        self.assertEqual(mgr.all_steps(), [2, 3])
+        self.assertEqual(mgr.latest_step, 3)
+        r = mgr.restore({"x": ht.zeros((12,), split=0)})
+        self.assert_array_equal(r["x"], (x * 3.0).numpy())
+        mgr.close()
+
+    def test_manager_empty_raises(self):
+        mgr = ht.CheckpointManager(os.path.join(self.tmp, "empty"))
+        with self.assertRaises(FileNotFoundError):
+            mgr.restore({"x": ht.zeros(3)})
+        mgr.close()
+
+    def test_training_resume_matches(self):
+        """Params + optimizer state checkpoint mid-training and resume identically."""
+        model = ht.nn.Sequential(ht.nn.Linear(4, 8), ht.nn.ReLU(), ht.nn.Linear(8, 2))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.05)
+        dp = ht.nn.DataParallel(model, optimizer=opt)
+        crit = ht.nn.CrossEntropyLoss()
+        rng = np.random.default_rng(0)
+        x = ht.array(rng.standard_normal((64, 4)).astype(np.float32), split=0)
+        y = ht.array(rng.integers(0, 2, 64), split=0)
+
+        def loss_fn(params, xb, yb):
+            return crit(model.apply(params, xb), yb)
+
+        for _ in range(3):
+            opt.step(loss_fn, x, y)
+        path = os.path.join(self.tmp, "resume")
+        ht.save_checkpoint({"params": model.params, "opt": opt._opt_state}, path)
+        continued = [float(opt.step(loss_fn, x, y)) for _ in range(2)]
+
+        # resume from the checkpoint into a fresh pipeline
+        model2 = ht.nn.Sequential(ht.nn.Linear(4, 8), ht.nn.ReLU(), ht.nn.Linear(8, 2))
+        opt2 = ht.optim.DataParallelOptimizer("sgd", lr=0.05)
+        dp2 = ht.nn.DataParallel(model2, optimizer=opt2)
+        opt2.step(lambda p, xb, yb: loss_fn(p, xb, yb), x, y)  # materialize opt state
+        back = ht.load_checkpoint({"params": model2.params, "opt": opt2._opt_state}, path)
+        model2.params = back["params"]
+        opt2._opt_state = back["opt"]
+
+        def loss_fn2(params, xb, yb):
+            return crit(model2.apply(params, xb), yb)
+
+        resumed = [float(opt2.step(loss_fn2, x, y)) for _ in range(2)]
+        np.testing.assert_allclose(resumed, continued, rtol=1e-6)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

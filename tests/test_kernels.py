@@ -1,0 +1,75 @@
+"""Pallas kernel tests: the fused KMeans assignment must agree with its jnp reference
+(validated in interpreter mode so the same test runs on the CPU mesh)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core.kernels import fused_assign_update, fused_assign_update_reference
+from heat_tpu.core.kernels.kmeans import _fused_pallas
+from heat_tpu.testing import TestCase
+
+
+class TestFusedAssignUpdate(TestCase):
+    def _check(self, n, d, k, seed=0):
+        rng = np.random.default_rng(seed)
+        x = jnp.asarray(rng.standard_normal((n, d)).astype(np.float32))
+        c = jnp.asarray(rng.standard_normal((k, d)).astype(np.float32))
+        l0, s0, n0, e0 = fused_assign_update_reference(x, c)
+        l1, s1, n1, e1 = _fused_pallas(x, c, interpret=True)
+        np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+        np.testing.assert_array_equal(np.asarray(n0), np.asarray(n1))
+        np.testing.assert_allclose(np.asarray(s0), np.asarray(s1), rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(float(e0), float(e1), rtol=1e-4)
+
+    def test_aligned(self):
+        self._check(1024, 64, 8)
+
+    def test_ragged_and_small(self):
+        self._check(130, 10, 3)  # n < block, unpadded d/k
+        self._check(1500, 7, 5)  # n needs padding
+
+    def test_reference_semantics(self):
+        """The reference itself matches a plain numpy computation."""
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((200, 6)).astype(np.float32)
+        c = rng.standard_normal((4, 6)).astype(np.float32)
+        d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        labels, sums, counts, sse = fused_assign_update_reference(
+            jnp.asarray(x), jnp.asarray(c)
+        )
+        np.testing.assert_array_equal(np.asarray(labels), d2.argmin(1))
+        np.testing.assert_allclose(float(sse), d2.min(1).sum(), rtol=1e-4)
+        for j in range(4):
+            np.testing.assert_allclose(
+                np.asarray(sums)[j], x[d2.argmin(1) == j].sum(0), rtol=1e-4, atol=1e-4
+            )
+
+    def test_dispatcher_fallback(self):
+        """On non-TPU backends the dispatcher returns the jnp reference results."""
+        if jax.default_backend() == "tpu":
+            self.skipTest("fallback path is the non-TPU branch")
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((300, 8)).astype(np.float32))
+        c = jnp.asarray(rng.standard_normal((5, 8)).astype(np.float32))
+        for a, b in zip(fused_assign_update(x, c), fused_assign_update_reference(x, c)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    def test_kmeans_unchanged_on_cpu(self):
+        """The Lloyd loop still converges identically through the generic path."""
+        rng = np.random.default_rng(3)
+        centers = rng.normal(0, 10, (3, 4)).astype(np.float32)
+        y = rng.integers(0, 3, 600)
+        x = ht.array(centers[y] + rng.normal(0, 0.3, (600, 4)).astype(np.float32), split=0)
+        km = ht.cluster.KMeans(n_clusters=3, init="kmeans++", max_iter=50, random_state=0)
+        km.fit(x)
+        got = np.sort(km.cluster_centers_.numpy(), axis=0)
+        np.testing.assert_allclose(got, np.sort(centers, axis=0), atol=0.2)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

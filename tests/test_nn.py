@@ -135,6 +135,23 @@ class TestMNISTExample(TestCase):
         self.assertGreater(acc, 0.95)
 
 
+class TestImagenetDASOExample(TestCase):
+    def test_daso_example_smoke(self):
+        """The hierarchical-DASO training example runs end to end and learns."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples", "nn"))
+        try:
+            import imagenet_daso
+        finally:
+            sys.path.pop(0)
+        acc = imagenet_daso.main(
+            ["--epochs", "12", "--n", "512", "--batch-size", "128", "--lr", "2e-2"]
+        )
+        self.assertGreater(acc, 0.5)  # far above the 0.1 chance level
+
+
 class TestDASO(TestCase):
     def _setup(self, total_epochs=10, warmup=2, cooldown=2):
         model = ht.nn.Sequential(ht.nn.Linear(2, 4), ht.nn.ReLU(), ht.nn.Linear(4, 2))

@@ -1,0 +1,448 @@
+"""Systematic op-parity sweeps vs numpy (reference pattern:
+test_suites/basic_test.py:138-299 — every function checked for every split axis).
+
+Complements the per-module test files with breadth: one sweep entry per public op,
+driven through ``assert_func_equal`` (3 dtypes × every split) or explicit
+mixed-split/broadcast fixtures.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestUnarySweeps(TestCase):
+    def test_rounding_and_sign(self):
+        data = np.array([[-2.7, -0.5, 0.0], [0.5, 1.5, 2.7]], np.float32)
+        for name in ("abs", "ceil", "floor", "trunc", "round", "sign", "neg", "positive"):
+            with self.subTest(name):
+                self.assert_func_equal(
+                    data,
+                    getattr(ht, name),
+                    getattr(np, {"neg": "negative", "round": "round"}.get(name, name)),
+                )
+
+    def test_trig_exp(self):
+        data = np.linspace(-1.4, 1.4, 12, dtype=np.float32).reshape(3, 4)
+        pairs = [
+            ("sin", np.sin), ("cos", np.cos), ("tan", np.tan),
+            ("arcsin", np.arcsin), ("arccos", np.arccos), ("arctan", np.arctan),
+            ("sinh", np.sinh), ("cosh", np.cosh), ("tanh", np.tanh),
+            ("exp", np.exp), ("expm1", np.expm1), ("exp2", np.exp2),
+            ("sqrt", lambda x: np.sqrt(np.abs(x))),
+            ("log", lambda x: np.log(np.abs(x) + 1.0)),
+        ]
+        for name, np_fn in pairs:
+            with self.subTest(name):
+                if name == "sqrt":
+                    ht_fn = lambda a: ht.sqrt(ht.abs(a))
+                elif name == "log":
+                    ht_fn = lambda a: ht.log(ht.abs(a) + 1.0)
+                else:
+                    ht_fn = getattr(ht, name)
+                self.assert_func_equal(data, ht_fn, np_fn)
+
+    def test_degrees_radians_deg2rad(self):
+        data = np.array([[0.0, 90.0], [180.0, -45.0]], np.float32)
+        self.assert_func_equal(data, ht.deg2rad, np.deg2rad)
+        self.assert_func_equal(data, ht.degrees, np.degrees)
+        self.assert_func_equal(data, ht.radians, np.radians)
+
+    def test_logical_unary(self):
+        data = np.array([[0, 1, 2], [0, 0, 3]], np.int32)
+        self.assert_func_equal(data, ht.logical_not, np.logical_not)
+        fdata = np.array([[np.nan, 1.0, np.inf], [-np.inf, 0.0, 2.0]], np.float32)
+        self.assert_func_equal(fdata, ht.isnan, np.isnan)
+        self.assert_func_equal(fdata, ht.isinf, np.isinf)
+        self.assert_func_equal(fdata, ht.isfinite, np.isfinite)
+        self.assert_func_equal(fdata, ht.nan_to_num, np.nan_to_num)
+
+
+class TestBinaryMixedSplits(TestCase):
+    """Every (split_a, split_b) combination, including broadcasting operands."""
+
+    def _sweep(self, ht_fn, np_fn, a, b, **kw):
+        expected = np_fn(a, b)
+        splits_a = [None] + list(range(a.ndim))
+        splits_b = [None] + list(range(b.ndim))
+        for sa in splits_a:
+            for sb in splits_b:
+                ha = ht.array(a, split=sa)
+                hb = ht.array(b, split=sb)
+                got = ht_fn(ha, hb)
+                np.testing.assert_allclose(
+                    got.numpy(), expected, rtol=1e-5, atol=1e-6,
+                    err_msg=f"{ht_fn.__name__} sa={sa} sb={sb}",
+                )
+
+    def test_arith_same_shape(self):
+        rng = np.random.default_rng(0)
+        a = rng.random((6, 5)).astype(np.float32) + 0.5
+        b = rng.random((6, 5)).astype(np.float32) + 0.5
+        for name in ("add", "sub", "mul", "div", "pow", "copysign", "hypot", "fmod"):
+            with self.subTest(name):
+                np_name = {
+                    "sub": "subtract", "mul": "multiply", "div": "divide",
+                    "pow": "power",
+                }.get(name, name)
+                self._sweep(getattr(ht, name), getattr(np, np_name), a, b)
+
+    def test_arith_broadcast(self):
+        rng = np.random.default_rng(1)
+        a = rng.random((4, 6)).astype(np.float32)
+        row = rng.random((6,)).astype(np.float32) + 0.5
+        col = rng.random((4, 1)).astype(np.float32) + 0.5
+        for b in (row, col):
+            self._sweep(ht.add, np.add, a, b)
+            self._sweep(ht.mul, np.multiply, a, b)
+            self._sweep(ht.div, np.divide, a, b)
+
+    def test_int_ops(self):
+        rng = np.random.default_rng(2)
+        a = rng.integers(1, 50, (5, 4)).astype(np.int32)
+        b = rng.integers(1, 8, (5, 4)).astype(np.int32)
+        for name, np_fn in (
+            ("floordiv", np.floor_divide), ("mod", np.mod), ("gcd", np.gcd),
+            ("lcm", np.lcm), ("left_shift", np.left_shift),
+            ("right_shift", np.right_shift), ("bitwise_and", np.bitwise_and),
+            ("bitwise_or", np.bitwise_or), ("bitwise_xor", np.bitwise_xor),
+        ):
+            with self.subTest(name):
+                self._sweep(getattr(ht, name), np_fn, a, b)
+
+    def test_relational(self):
+        rng = np.random.default_rng(3)
+        a = rng.integers(0, 4, (5, 6)).astype(np.int32)
+        b = rng.integers(0, 4, (5, 6)).astype(np.int32)
+        for name, np_fn in (
+            ("eq", np.equal), ("ne", np.not_equal), ("lt", np.less),
+            ("le", np.less_equal), ("gt", np.greater), ("ge", np.greater_equal),
+        ):
+            with self.subTest(name):
+                self._sweep(getattr(ht, name), np_fn, a, b)
+
+    def test_logical_binary(self):
+        a = np.array([[True, False], [True, True]])
+        b = np.array([[False, False], [True, False]])
+        for name in ("logical_and", "logical_or", "logical_xor"):
+            with self.subTest(name):
+                self._sweep(getattr(ht, name), getattr(np, name), a, b)
+
+    def test_divmod(self):
+        a = np.array([[7.0, -7.0], [9.5, 3.25]], np.float32)
+        b = np.array([[2.0, 2.0], [3.0, -0.5]], np.float32)
+        q, r = ht.divmod(ht.array(a, split=0), ht.array(b, split=1))
+        eq, er = np.divmod(a, b)
+        np.testing.assert_allclose(q.numpy(), eq, rtol=1e-6)
+        np.testing.assert_allclose(r.numpy(), er, rtol=1e-5, atol=1e-6)
+
+
+class TestReductionSweeps(TestCase):
+    def test_sum_prod_axes(self):
+        rng = np.random.default_rng(4)
+        data = (rng.random((4, 5, 3)) + 0.5).astype(np.float32)
+        for name, np_fn in (("sum", np.sum), ("prod", np.prod),
+                            ("max", np.max), ("min", np.min),
+                            ("mean", np.mean)):
+            for axis in (None, 0, 1, 2, (0, 2)):
+                for keepdims in (False, True):
+                    with self.subTest(name=name, axis=axis, keepdims=keepdims):
+                        self.assert_func_equal(
+                            data,
+                            lambda a, n=name, ax=axis, k=keepdims: getattr(ht, n)(
+                                a, axis=ax, keepdims=k
+                            ),
+                            lambda a, f=np_fn, ax=axis, k=keepdims: f(
+                                a, axis=ax, keepdims=k
+                            ),
+                        )
+
+    def test_nan_reductions(self):
+        data = np.array([[1.0, np.nan, 2.0], [np.nan, 3.0, 4.0]], np.float32)
+        for axis in (None, 0, 1):
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.nansum(a, axis=ax),
+                lambda a, ax=axis: np.nansum(a, axis=ax),
+            )
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.nanprod(a, axis=ax),
+                lambda a, ax=axis: np.nanprod(a, axis=ax),
+            )
+
+    def test_var_std_ddof(self):
+        rng = np.random.default_rng(5)
+        data = rng.random((6, 4)).astype(np.float32) * 10
+        for ddof in (0, 1):
+            for axis in (None, 0, 1):
+                with self.subTest(ddof=ddof, axis=axis):
+                    self.assert_func_equal(
+                        data,
+                        lambda a, ax=axis, d=ddof: ht.var(a, axis=ax, ddof=d),
+                        lambda a, ax=axis, d=ddof: np.var(a, axis=ax, ddof=d),
+                    )
+                    self.assert_func_equal(
+                        data,
+                        lambda a, ax=axis, d=ddof: ht.std(a, axis=ax, ddof=d),
+                        lambda a, ax=axis, d=ddof: np.std(a, axis=ax, ddof=d),
+                    )
+
+    def test_cum_ops(self):
+        rng = np.random.default_rng(6)
+        data = (rng.random((5, 6)) + 0.5).astype(np.float32)
+        for axis in (0, 1):
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.cumsum(a, axis=ax),
+                lambda a, ax=axis: np.cumsum(a, axis=ax),
+            )
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.cumprod(a, axis=ax),
+                lambda a, ax=axis: np.cumprod(a, axis=ax),
+            )
+
+    def test_argreductions(self):
+        rng = np.random.default_rng(7)
+        data = rng.permutation(30).reshape(5, 6).astype(np.float32)
+        for axis in (None, 0, 1):
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.argmax(a, axis=ax),
+                lambda a, ax=axis: np.argmax(a, axis=ax),
+            )
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.argmin(a, axis=ax),
+                lambda a, ax=axis: np.argmin(a, axis=ax),
+            )
+
+    def test_all_any(self):
+        data = np.array([[1, 0, 2], [3, 4, 0]], np.int32)
+        for axis in (None, 0, 1):
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.all(a, axis=ax),
+                lambda a, ax=axis: np.all(a, axis=ax),
+            )
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.any(a, axis=ax),
+                lambda a, ax=axis: np.any(a, axis=ax),
+            )
+
+    def test_diff(self):
+        rng = np.random.default_rng(8)
+        data = rng.random((5, 7)).astype(np.float32)
+        for axis in (0, 1):
+            for n in (1, 2):
+                self.assert_func_equal(
+                    data,
+                    lambda a, ax=axis, nn=n: ht.diff(a, n=nn, axis=ax),
+                    lambda a, ax=axis, nn=n: np.diff(a, n=nn, axis=ax),
+                )
+
+
+class TestManipulationSweeps(TestCase):
+    def test_concat_stack_all_split_combos(self):
+        rng = np.random.default_rng(9)
+        a = rng.random((4, 5)).astype(np.float32)
+        b = rng.random((4, 5)).astype(np.float32)
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                ha, hb = ht.array(a, split=sa), ht.array(b, split=sb)
+                for axis in (0, 1):
+                    got = ht.concatenate([ha, hb], axis=axis)
+                    np.testing.assert_allclose(
+                        got.numpy(), np.concatenate([a, b], axis=axis), rtol=1e-6,
+                        err_msg=f"concat sa={sa} sb={sb} axis={axis}",
+                    )
+                got = ht.stack([ha, hb], axis=0)
+                np.testing.assert_allclose(got.numpy(), np.stack([a, b]), rtol=1e-6)
+
+    def test_reshape_family(self):
+        rng = np.random.default_rng(10)
+        data = rng.random((4, 6)).astype(np.float32)
+        self.assert_func_equal(data, lambda a: ht.reshape(a, (8, 3)), lambda a: a.reshape(8, 3))
+        self.assert_func_equal(data, ht.ravel, np.ravel)
+        self.assert_func_equal(data, ht.flatten, np.ravel)
+        self.assert_func_equal(
+            data, lambda a: ht.expand_dims(a, 1), lambda a: np.expand_dims(a, 1)
+        )
+        sq = data.reshape(4, 1, 6)
+        self.assert_func_equal(sq, ht.squeeze, np.squeeze)
+
+    def test_flip_roll_rot(self):
+        rng = np.random.default_rng(11)
+        data = rng.random((4, 6)).astype(np.float32)
+        for axis in (0, 1, None):
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.flip(a, ax),
+                lambda a, ax=axis: np.flip(a, ax),
+            )
+            for shift in (1, -2, 7):
+                self.assert_func_equal(
+                    data,
+                    lambda a, s=shift, ax=axis: ht.roll(a, s, axis=ax),
+                    lambda a, s=shift, ax=axis: np.roll(a, s, axis=ax),
+                )
+        self.assert_func_equal(data, ht.fliplr, np.fliplr)
+        self.assert_func_equal(data, ht.flipud, np.flipud)
+        for k in (1, 2, 3):
+            self.assert_func_equal(
+                data, lambda a, kk=k: ht.rot90(a, kk), lambda a, kk=k: np.rot90(a, kk)
+            )
+
+    def test_repeat_tile(self):
+        data = np.arange(12, dtype=np.float32).reshape(3, 4)
+        self.assert_func_equal(
+            data, lambda a: ht.repeat(a, 3), lambda a: np.repeat(a, 3)
+        )
+        self.assert_func_equal(
+            data, lambda a: ht.repeat(a, 2, axis=1), lambda a: np.repeat(a, 2, axis=1)
+        )
+        self.assert_func_equal(
+            data, lambda a: ht.tile(a, (2, 3)), lambda a: np.tile(a, (2, 3))
+        )
+
+    def test_pad_modes(self):
+        data = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for width in (1, (1, 2), ((1, 0), (0, 2))):
+            self.assert_func_equal(
+                data,
+                lambda a, w=width: ht.pad(a, w),
+                lambda a, w=width: np.pad(a, w),
+            )
+
+    def test_axis_moves(self):
+        rng = np.random.default_rng(12)
+        data = rng.random((3, 4, 5)).astype(np.float32)
+        self.assert_func_equal(
+            data, lambda a: ht.moveaxis(a, 0, 2), lambda a: np.moveaxis(a, 0, 2)
+        )
+        self.assert_func_equal(
+            data, lambda a: ht.swapaxes(a, 0, 1), lambda a: np.swapaxes(a, 0, 1)
+        )
+
+    def test_sort_unique_topk(self):
+        rng = np.random.default_rng(13)
+        data = rng.integers(0, 20, (5, 8)).astype(np.float32)
+        for axis in (0, 1):
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.sort(a, axis=ax)[0],
+                lambda a, ax=axis: np.sort(a, axis=ax),
+            )
+        self.assert_func_equal(data, lambda a: ht.unique(a, sorted=True), np.unique)
+        # topk values match numpy's sorted tail
+        for split in (None, 0, 1):
+            h = ht.array(data, split=split)
+            v, idx = ht.topk(h, 3, dim=1)
+            np.testing.assert_allclose(
+                v.numpy(), -np.sort(-data, axis=1)[:, :3], rtol=1e-6
+            )
+
+    def test_diag_family(self):
+        data = np.arange(16, dtype=np.float32).reshape(4, 4)
+        self.assert_func_equal(data, ht.diagonal, np.diagonal)
+        vec = np.arange(4, dtype=np.float32)
+        self.assert_func_equal(vec, ht.diag, np.diag)
+        self.assert_func_equal(data, ht.tril, np.tril)
+        self.assert_func_equal(data, ht.triu, np.triu)
+
+    def test_split_family(self):
+        data = np.arange(24, dtype=np.float32).reshape(4, 6)
+        for split in (None, 0, 1):
+            h = ht.array(data, split=split)
+            for ht_fn, np_fn, arg in (
+                (ht.hsplit, np.hsplit, 3),
+                (ht.vsplit, np.vsplit, 2),
+                (ht.split, np.split, 2),
+            ):
+                got = ht_fn(h, arg)
+                expected = np_fn(data, arg)
+                self.assertEqual(len(got), len(expected))
+                for g, e in zip(got, expected):
+                    np.testing.assert_allclose(g.numpy(), e, rtol=1e-6)
+
+    def test_broadcast_ops(self):
+        data = np.arange(6, dtype=np.float32).reshape(1, 6)
+        self.assert_func_equal(
+            data,
+            lambda a: ht.broadcast_to(a, (4, 6)),
+            lambda a: np.broadcast_to(a, (4, 6)),
+        )
+
+
+class TestStatisticsSweeps(TestCase):
+    def test_median_percentile(self):
+        rng = np.random.default_rng(14)
+        data = rng.random((6, 5)).astype(np.float32) * 100
+        for axis in (None, 0, 1):
+            self.assert_func_equal(
+                data,
+                lambda a, ax=axis: ht.median(a, axis=ax),
+                lambda a, ax=axis: np.median(a, axis=ax),
+            )
+        for q in (25.0, 50.0, 90.0):
+            self.assert_func_equal(
+                data,
+                lambda a, qq=q: ht.percentile(a, qq),
+                lambda a, qq=q: np.percentile(a, qq),
+            )
+
+    def test_cov_average(self):
+        rng = np.random.default_rng(15)
+        data = rng.random((4, 9)).astype(np.float32)
+        self.assert_func_equal(data, ht.cov, np.cov, data_types=(np.float32,))
+        w = rng.random(4).astype(np.float32) + 0.1
+        for split in (None, 0):
+            h = ht.array(data, split=split)
+            got = ht.average(h, axis=0, weights=ht.array(w, split=split))
+            np.testing.assert_allclose(
+                got.numpy(), np.average(data, axis=0, weights=w), rtol=1e-5
+            )
+
+    def test_hist_digitize(self):
+        rng = np.random.default_rng(16)
+        data = (rng.random(50) * 10).astype(np.float32)
+        for split in (None, 0):
+            h = ht.array(data, split=split)
+            got = ht.histc(h, bins=10, min=0.0, max=10.0)
+            expected, _ = np.histogram(data, bins=10, range=(0.0, 10.0))
+            np.testing.assert_array_equal(got.numpy(), expected)
+            bins = np.array([2.0, 4.0, 6.0, 8.0], np.float32)
+            np.testing.assert_array_equal(
+                ht.digitize(h, ht.array(bins)).numpy(), np.digitize(data, bins)
+            )
+
+    def test_bincount_skew_kurtosis(self):
+        data = np.array([0, 1, 1, 3, 2, 1, 7], np.int32)
+        for split in (None, 0):
+            h = ht.array(data, split=split)
+            np.testing.assert_array_equal(ht.bincount(h).numpy(), np.bincount(data))
+        rng = np.random.default_rng(17)
+        x = rng.standard_normal(200).astype(np.float32)
+        try:
+            from scipy import stats as sps
+
+            np.testing.assert_allclose(
+                float(ht.skew(ht.array(x, split=0))), sps.skew(x, bias=False), rtol=1e-3
+            )
+            np.testing.assert_allclose(
+                float(ht.kurtosis(ht.array(x, split=0))),
+                sps.kurtosis(x, bias=False),
+                rtol=1e-3,
+                atol=1e-3,
+            )
+        except ImportError:
+            pass
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -1,0 +1,88 @@
+"""vision_transforms tests (reference heat/utils/tests: the passthrough is tested via
+torchvision; here the native transforms are checked against numpy directly)."""
+
+import numpy as np
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu.utils import vision_transforms as T
+from heat_tpu.testing import TestCase
+
+
+class TestVisionTransforms(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(0)
+        self.batch = rng.integers(0, 256, (8, 3, 16, 16)).astype(np.uint8)
+
+    def test_to_tensor(self):
+        out = T.ToTensor()(self.batch)
+        self.assertEqual(out.dtype, np.float32)
+        np.testing.assert_allclose(np.asarray(out), self.batch / 255.0, rtol=1e-6)
+
+    def test_normalize(self):
+        x = self.batch.astype(np.float32)
+        mean, std = [1.0, 2.0, 3.0], [2.0, 2.0, 2.0]
+        out = np.asarray(T.Normalize(mean, std)(x))
+        expected = (x - np.reshape(mean, (3, 1, 1))) / np.reshape(std, (3, 1, 1))
+        np.testing.assert_allclose(out, expected, rtol=1e-6)
+        # 2-D grayscale: scalar mean/std
+        g = x[0, 0]
+        np.testing.assert_allclose(
+            np.asarray(T.Normalize(5.0, 2.0)(g)), (g - 5.0) / 2.0, rtol=1e-6
+        )
+
+    def test_flips(self):
+        x = self.batch.astype(np.float32)
+        always = T.RandomHorizontalFlip(1.0)(x, key=jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(always), x[..., ::-1])
+        never = T.RandomHorizontalFlip(0.0)(x, key=jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(never), x)
+        vert = T.RandomVerticalFlip(1.0)(x, key=jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(vert), x[..., ::-1, :])
+        # per-sample decision for batches: p=0.5 flips some, not all
+        T.seed(3)
+        some = np.asarray(T.RandomHorizontalFlip(0.5)(x))
+        flipped = [not np.array_equal(some[i], x[i]) for i in range(len(x))]
+        self.assertTrue(any(flipped) and not all(flipped))
+
+    def test_crops(self):
+        x = self.batch.astype(np.float32)
+        out = T.RandomCrop(8)(x, key=jax.random.key(1))
+        self.assertEqual(np.asarray(out).shape, (8, 3, 8, 8))
+        out = T.RandomCrop(16, padding=2)(x, key=jax.random.key(1))
+        self.assertEqual(np.asarray(out).shape, (8, 3, 16, 16))
+        cc = np.asarray(T.CenterCrop(8)(x))
+        np.testing.assert_array_equal(cc, x[:, :, 4:12, 4:12])
+
+    def test_resize(self):
+        x = self.batch.astype(np.float32)
+        out = np.asarray(T.Resize((8, 8))(x))
+        self.assertEqual(out.shape, (8, 3, 8, 8))
+        # constant image stays constant under bilinear resize
+        const = np.full((3, 16, 16), 7.0, np.float32)
+        np.testing.assert_allclose(np.asarray(T.Resize(4)(const)), 7.0, rtol=1e-5)
+
+    def test_compose_and_dndarray(self):
+        pipeline = T.Compose(
+            [T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3), T.CenterCrop(8)]
+        )
+        out = np.asarray(pipeline(self.batch))
+        self.assertEqual(out.shape, (8, 3, 8, 8))
+        # DNDarray in → DNDarray out, split preserved on the batch axis
+        hx = ht.array(self.batch, split=0)
+        hout = pipeline(hx)
+        self.assertIsInstance(hout, ht.DNDarray)
+        self.assertEqual(hout.split, 0)
+        np.testing.assert_allclose(hout.numpy(), out, rtol=1e-5)
+        self.assertIn("Compose", repr(pipeline))
+
+    def test_errors(self):
+        with self.assertRaises(ValueError):
+            T.CenterCrop(4)(np.zeros((2, 2, 2, 2, 2), np.float32))
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
