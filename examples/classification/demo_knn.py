@@ -1,0 +1,49 @@
+"""KNN demo (reference examples/classification/demo_knn.py): leave-some-out accuracy
+of KNeighborsClassifier on the packaged flowers dataset (the iris-shaped fixture)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.classification.kneighborsclassifier import KNeighborsClassifier
+
+
+def calculate_accuracy(new_y, verification_y):
+    """Fraction of correctly labeled samples (reference ``demo_knn.py:28-58``)."""
+    if new_y.gshape != verification_y.gshape:
+        raise ValueError(
+            f"Expecting results of same length, got {new_y.gshape}, {verification_y.gshape}"
+        )
+    count = ht.sum(ht.where(new_y == verification_y, 1, 0))
+    return float(count) / new_y.gshape[0]
+
+
+def main(k: int = 5, verification_fraction: float = 0.3, seed: int = 1):
+    X = ht.load(ht.datasets.path("flowers.h5"), dataset="data", split=0)
+    labels = np.repeat([0, 1, 2], 50)
+    Y = ht.array(labels, split=0)
+
+    # split off a verification set (reference shuffles keys with random.sample)
+    rng = np.random.default_rng(seed)
+    n = X.gshape[0]
+    idx = rng.permutation(n)
+    n_verify = int(n * verification_fraction)
+    train_idx, verify_idx = np.sort(idx[n_verify:]), np.sort(idx[:n_verify])
+
+    x_train, y_train = X[train_idx], Y[train_idx]
+    x_verify, y_verify = X[verify_idx], Y[verify_idx]
+
+    knn = KNeighborsClassifier(n_neighbors=k)
+    knn.fit(x_train, y_train)
+    pred = knn.predict(x_verify)
+    accuracy = calculate_accuracy(pred.flatten(), y_verify.flatten())
+    print(f"KNN (k={k}) verification accuracy: {accuracy:.3f}")
+    return accuracy
+
+
+if __name__ == "__main__":
+    main()

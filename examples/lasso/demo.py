@@ -1,0 +1,58 @@
+"""Lasso path demo (reference examples/lasso/demo.py): coordinate-descent lasso over a
+range of regularization strengths on the packaged regression dataset (``sugar.h5``,
+the diabetes-shaped fixture), printing the coefficient path. Plotting is optional —
+matplotlib renders to ``lasso_paths.png`` when available (reference uses plotfkt)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import heat_tpu as ht
+import heat_tpu.regression.lasso as lasso
+
+
+def main():
+    X = ht.load(ht.datasets.path("sugar.h5"), dataset="x", split=0)
+    y = ht.load(ht.datasets.path("sugar.h5"), dataset="y", split=0)
+
+    # normalize (reference demo.py:28)
+    X = X / ht.sqrt(ht.mean(X**2, axis=0))
+
+    estimator = lasso.Lasso(max_iter=100)
+    lamda = np.logspace(0, 4, 10) / 10
+
+    theta_list = []
+    for la in lamda:
+        estimator.lam = float(la)
+        estimator.fit(X, y)
+        theta_list.append(estimator.theta.numpy().flatten())
+    theta_lasso = np.stack(theta_list).T[1:, :]
+
+    nonzero = (np.abs(theta_lasso) > 1e-8).sum(axis=0)
+    for la, nz in zip(lamda, nonzero):
+        print(f"lambda={la:8.3f}  nonzero coefficients: {nz}/{theta_lasso.shape[0]}")
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from matplotlib import pyplot as plt
+
+        plt.figure(figsize=(8, 5))
+        for row in theta_lasso:
+            plt.semilogx(lamda, row)
+        plt.xlabel("lambda")
+        plt.ylabel("coefficient")
+        plt.title("Lasso paths - heat_tpu implementation")
+        plt.savefig(os.path.join(os.path.dirname(os.path.abspath(__file__)), "lasso_paths.png"))
+        print("wrote lasso_paths.png")
+    except ImportError:
+        pass
+    return theta_lasso
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,10 @@ class KMeans(_KCluster):
 
         if jax.default_backend() != "tpu":
             return None
+        # the kernel computes in f32; float64 fits must keep the generic path to
+        # preserve x64 numerics
+        if ht.promote_types(x.dtype, ht.float32) is not ht.float32:
+            return None
         from ..core.kernels import fused_assign_update
 
         comm = x.comm

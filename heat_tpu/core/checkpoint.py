@@ -50,8 +50,9 @@ def _to_storable(tree: Any):
 def _rebuild_tree(tree: Any, restored: dict, comm, device) -> Any:
     """Reassemble the caller's pytree from a restored payload.
 
-    DNDarray leaves come back with the *template's* split (the documented contract:
-    the tree passed to restore decides the target distribution); the split stored at
+    DNDarray leaves come back with the *template's* split, comm, and device (the
+    documented contract: the tree passed to restore decides the target distribution;
+    explicit ``comm=``/``device=`` arguments override per-leaf); the split stored at
     save time is metadata for structure-free consumers.
     """
     treedef = jax.tree.structure(tree)
@@ -64,15 +65,17 @@ def _rebuild_tree(tree: Any, restored: dict, comm, device) -> Any:
             out_leaves.append(value)
         else:
             split_ax = leaf.split
-            arr = comm.shard(jax.numpy.asarray(value), split_ax)
+            leaf_comm = comm if comm is not None else leaf.comm
+            leaf_device = device if device is not None else leaf.device
+            arr = leaf_comm.shard(jax.numpy.asarray(value), split_ax)
             out_leaves.append(
                 DNDarray(
                     arr,
                     tuple(arr.shape),
                     _types.canonical_heat_type(arr.dtype),
                     split_ax,
-                    device,
-                    comm,
+                    leaf_device,
+                    leaf_comm,
                     True,
                 )
             )
@@ -110,8 +113,8 @@ def load_checkpoint(
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
-    comm = sanitize_comm(comm)
-    device = sanitize_device(device)
+    comm = sanitize_comm(comm) if comm is not None else None
+    device = sanitize_device(device) if device is not None else None
     _, arrays, _ = _to_storable(tree)
     ckptr = ocp.StandardCheckpointer()
     restored = ckptr.restore(
@@ -149,8 +152,8 @@ class CheckpointManager:
     def restore(self, tree: Any, step: Optional[int] = None, *, device=None, comm=None) -> Any:
         import orbax.checkpoint as ocp
 
-        comm = sanitize_comm(comm)
-        device = sanitize_device(device)
+        comm = sanitize_comm(comm) if comm is not None else None
+        device = sanitize_device(device) if device is not None else None
         if step is None:
             step = self._manager.latest_step()
             if step is None:

@@ -149,4 +149,15 @@ def fused_assign_update(
     """
     if not interpret and jax.default_backend() != "tpu":
         return fused_assign_update_reference(xv, centers)
+    if not _fits_vmem(xv.shape[1], centers.shape[0]):
+        return fused_assign_update_reference(xv, centers)
     return _fused_pallas(xv, centers, interpret=interpret)
+
+
+def _fits_vmem(d: int, k: int, block_n: int = 1024, budget_bytes: int = 8 * 2**20) -> bool:
+    """Conservative VMEM gate: the kernel keeps the (bn,d) x block, (k,d) centers +
+    sums, the (bn,k) distance/one-hot tiles, and working copies resident; wide or
+    many-cluster inputs must fall back to the jnp path instead of failing Mosaic
+    compilation with a VMEM-exceeded error."""
+    resident = 4 * (2 * block_n * d + 3 * k * d + 3 * block_n * k)
+    return resident <= budget_bytes

@@ -41,6 +41,8 @@ __all__ = [
     "Flatten",
     "Dropout",
     "Dropout2d",
+    "Remat",
+    "remat",
     "Sequential",
     "MSELoss",
     "L1Loss",
@@ -428,6 +430,33 @@ class Dropout2d(Module):
         if not train or self.p == 0.0:
             return x
         return F.dropout2d(x, self.p, training=True, key=key)
+
+
+class Remat(Module):
+    """Gradient checkpointing wrapper: recompute the wrapped module's forward during
+    the backward pass instead of storing activations (``jax.checkpoint``) — the
+    HBM-for-FLOPs trade that makes long sequences / deep nets fit on TPU. No torch
+    equivalent in the reference (torch.utils.checkpoint is the analogue)."""
+
+    def __init__(self, module: Module):
+        self.module = module
+
+    def named_submodules(self):
+        return [("module", self.module)]
+
+    def init(self, key):
+        return self.module.init(key)
+
+    def apply(self, params, x, *, key=None, train=False):
+        import functools
+
+        fn = functools.partial(self.module.apply, key=key, train=train)
+        return jax.checkpoint(fn)(params, x)
+
+
+def remat(module: Module) -> Remat:
+    """Functional alias for :class:`Remat`."""
+    return Remat(module)
 
 
 class Sequential(Module):

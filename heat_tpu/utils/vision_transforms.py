@@ -56,14 +56,12 @@ def _unwrap(x):
 def _rewrap(value, proto):
     if proto is None:
         return value
-    from ..core.dndarray import DNDarray
-    from ..core import types
+    from ..core._operations import wrap_result
 
-    split = proto.split if proto.split == 0 else None
-    return DNDarray(
-        proto.comm.shard(value, split), tuple(value.shape),
-        types.canonical_heat_type(value.dtype), split, proto.device, proto.comm, True,
-    )
+    # preserve the prototype's split whenever the dimension survived (crops/resizes
+    # keep every axis, so any valid split carries over)
+    split = proto.split if proto.split is not None and proto.split < value.ndim else None
+    return wrap_result(value, proto, split)
 
 
 def _spatial_axes(ndim: int) -> Tuple[int, int]:

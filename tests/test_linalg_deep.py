@@ -1,0 +1,183 @@
+"""Deep linalg parity sweeps (reference heat/core/linalg/tests/test_basics.py, 2157
+LoC: the matmul split-case matrix is its core — every (a.split, b.split) combination
+against numpy, plus vector/batched shapes and the norm family)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestMatmulMatrix(TestCase):
+    def _combos(self, a, b, **kw):
+        expected = a @ b
+        for sa in [None] + list(range(a.ndim)):
+            for sb in [None] + list(range(b.ndim)):
+                ha = ht.array(a, split=sa)
+                hb = ht.array(b, split=sb)
+                got = ht.matmul(ha, hb)
+                np.testing.assert_allclose(
+                    got.numpy(), expected, rtol=2e-4, atol=1e-4,
+                    err_msg=f"sa={sa} sb={sb} shapes={a.shape}x{b.shape}",
+                )
+
+    def test_square(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((9, 9)).astype(np.float32)
+        b = rng.standard_normal((9, 9)).astype(np.float32)
+        self._combos(a, b)
+
+    def test_rectangular(self):
+        rng = np.random.default_rng(1)
+        self._combos(
+            rng.standard_normal((11, 5)).astype(np.float32),
+            rng.standard_normal((5, 7)).astype(np.float32),
+        )
+
+    def test_vector_cases(self):
+        rng = np.random.default_rng(2)
+        m = rng.standard_normal((6, 4)).astype(np.float32)
+        v4 = rng.standard_normal(4).astype(np.float32)
+        v6 = rng.standard_normal(6).astype(np.float32)
+        self._combos(m, v4)  # matrix @ vector
+        self._combos(v6, m)  # vector @ matrix
+        self._combos(v4, v4[:, None] @ np.ones((1, 3), np.float32))  # vec @ matrix
+
+    def test_batched(self):
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((3, 5, 4)).astype(np.float32)
+        b = rng.standard_normal((3, 4, 6)).astype(np.float32)
+        self._combos(a, b)
+
+    def test_dtype_promotion(self):
+        a = np.arange(12).reshape(4, 3).astype(np.int32)
+        b = np.ones((3, 2), np.float32)
+        got = ht.matmul(ht.array(a, split=0), ht.array(b, split=1))
+        np.testing.assert_allclose(got.numpy(), a @ b, rtol=1e-5)
+
+    def test_result_split_rules(self):
+        a = ht.ones((8, 4), split=0)
+        b = ht.ones((4, 6), split=1)
+        self.assertEqual(ht.matmul(a, b).split, 0)  # row-split a wins
+        self.assertEqual(ht.matmul(a.resplit(None), b).split, 1)  # col-split b
+        self.assertEqual(ht.matmul(a.resplit(1), b.resplit(None)).split, None)  # contraction
+        bt = ht.ones((3, 4, 6), split=0)
+        at = ht.ones((3, 8, 4), split=0)
+        self.assertEqual(ht.matmul(at, bt).split, 0)  # batch dim preserved
+
+
+class TestNormFamily(TestCase):
+    def test_vector_norm_orders(self):
+        rng = np.random.default_rng(4)
+        v = rng.standard_normal(20).astype(np.float32)
+        for split in (None, 0):
+            h = ht.array(v, split=split)
+            for order in (1, 2, np.inf):
+                np.testing.assert_allclose(
+                    float(ht.vector_norm(h, ord=order)),
+                    np.linalg.norm(v, ord=order),
+                    rtol=1e-5,
+                )
+
+    def test_matrix_norm_orders(self):
+        rng = np.random.default_rng(5)
+        m = rng.standard_normal((6, 8)).astype(np.float32)
+        for split in (None, 0, 1):
+            h = ht.array(m, split=split)
+            for order in ("fro", 1, np.inf):
+                np.testing.assert_allclose(
+                    float(ht.matrix_norm(h, ord=order)),
+                    np.linalg.norm(m, ord=order),
+                    rtol=1e-5,
+                    err_msg=f"split={split} ord={order}",
+                )
+
+    def test_norm_axis(self):
+        rng = np.random.default_rng(6)
+        m = rng.standard_normal((5, 7)).astype(np.float32)
+        for split in (None, 0, 1):
+            h = ht.array(m, split=split)
+            for axis in (0, 1):
+                np.testing.assert_allclose(
+                    ht.norm(h, axis=axis).numpy(), np.linalg.norm(m, axis=axis), rtol=1e-5
+                )
+
+
+class TestSmallAlgebra(TestCase):
+    def test_cross_vecdot_projection(self):
+        rng = np.random.default_rng(7)
+        a = rng.standard_normal((4, 3)).astype(np.float32)
+        b = rng.standard_normal((4, 3)).astype(np.float32)
+        for split in (None, 0):
+            ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+            np.testing.assert_allclose(ht.cross(ha, hb).numpy(), np.cross(a, b), rtol=1e-5)
+            np.testing.assert_allclose(
+                ht.vecdot(ha, hb).numpy(), np.einsum("ij,ij->i", a, b), rtol=1e-5
+            )
+        u = ht.array(np.array([1.0, 0.0, 0.0], np.float32))
+        v = ht.array(np.array([3.0, 4.0, 5.0], np.float32))
+        np.testing.assert_allclose(
+            ht.linalg.projection(v, u).numpy(), [3.0, 0.0, 0.0], rtol=1e-6
+        )
+
+    def test_inv_random(self):
+        rng = np.random.default_rng(8)
+        m = rng.standard_normal((6, 6)).astype(np.float32) + 6 * np.eye(6, dtype=np.float32)
+        for split in (None, 0, 1):
+            got = ht.linalg.inv(ht.array(m, split=split))
+            np.testing.assert_allclose(got.numpy() @ m, np.eye(6), atol=1e-3)
+
+    def test_det_trace_parity(self):
+        rng = np.random.default_rng(9)
+        m = rng.standard_normal((5, 5)).astype(np.float64)
+        for split in (None, 0, 1):
+            h = ht.array(m, split=split)
+            np.testing.assert_allclose(float(ht.linalg.det(h)), np.linalg.det(m), rtol=1e-8)
+            np.testing.assert_allclose(float(ht.trace(h)), np.trace(m), rtol=1e-10)
+
+    def test_outer_splits(self):
+        a = np.arange(5, dtype=np.float32)
+        b = np.arange(7, dtype=np.float32) + 1
+        for sa in (None, 0):
+            for sb in (None, 0):
+                got = ht.linalg.outer(ht.array(a, split=sa), ht.array(b, split=sb))
+                np.testing.assert_allclose(got.numpy(), np.outer(a, b), rtol=1e-6)
+
+
+class TestQRDeep(TestCase):
+    def test_qr_shapes_sweep(self):
+        rng = np.random.default_rng(10)
+        for m, n in ((self.world_size * 16, 4), (40, 8), (12, 12)):
+            a_np = rng.standard_normal((m, n)).astype(np.float32)
+            for split in (None, 0, 1):
+                q, r = ht.linalg.qr(ht.array(a_np, split=split))
+                np.testing.assert_allclose(
+                    (q @ r).numpy(), a_np, atol=1e-4, err_msg=f"m={m} n={n} split={split}"
+                )
+                qn = q.numpy()
+                np.testing.assert_allclose(
+                    qn.T @ qn, np.eye(qn.shape[1]), atol=1e-4
+                )
+                # R upper-triangular
+                rn = r.numpy()
+                np.testing.assert_allclose(rn, np.triu(rn), atol=1e-5)
+
+    def test_hsvd_reconstruction_quality(self):
+        rng = np.random.default_rng(11)
+        u = rng.standard_normal((64, 6)).astype(np.float32)
+        v = rng.standard_normal((6, self.world_size * 40)).astype(np.float32)
+        a_np = u @ v
+        a = ht.array(a_np, split=1)
+        U, sv, V, err = ht.linalg.hsvd_rank(a, 6, compute_sv=True)
+        # rank-6 matrix: the rank-6 truncation reconstructs to f32 accuracy
+        self.assertLessEqual(float(err), 1e-3)
+        approx = U.numpy() @ np.diag(sv.numpy().ravel()) @ V.numpy().T
+        np.testing.assert_allclose(
+            approx, a_np, atol=1e-2 * np.abs(a_np).max()
+        )
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -119,6 +119,47 @@ class TestDataParallelTraining(TestCase):
             ht.optim.DataParallelOptimizer(blocking="yes")
 
 
+class TestRemat(TestCase):
+    def test_remat_same_values_and_grads(self):
+        import jax
+        import jax.numpy as jnp
+
+        inner = ht.nn.Sequential(ht.nn.Linear(6, 16), ht.nn.Tanh(), ht.nn.Linear(16, 3))
+        wrapped = ht.nn.remat(inner)
+        params = inner.init(jax.random.key(0))
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((10, 6)), jnp.float32)
+
+        def loss_plain(p):
+            return jnp.sum(inner.apply(p, x) ** 2)
+
+        def loss_remat(p):
+            return jnp.sum(wrapped.apply(p, x) ** 2)
+
+        np.testing.assert_allclose(
+            float(loss_plain(params)), float(loss_remat(params)), rtol=1e-6
+        )
+        g0 = jax.grad(loss_plain)(params)
+        g1 = jax.grad(loss_remat)(params)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    def test_remat_trains_in_dp(self):
+        model = ht.nn.remat(
+            ht.nn.Sequential(ht.nn.Linear(2, 16), ht.nn.ReLU(), ht.nn.Linear(16, 2))
+        )
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.2)
+        ht.nn.DataParallel(model, optimizer=opt)
+        crit = ht.nn.CrossEntropyLoss()
+        x_np, y_np = _make_blobs()
+        x, y = ht.array(x_np, split=0), ht.array(y_np, split=0)
+
+        def loss_fn(params, xb, yb):
+            return crit(model.apply(params, xb), yb)
+
+        losses = [float(opt.step(loss_fn, x, y)) for _ in range(30)]
+        self.assertLess(losses[-1], losses[0] * 0.5)
+
+
 class TestMNISTExample(TestCase):
     def test_cnn_gate(self):
         """The reference's own conv net (examples/nn/mnist.py:26-43) must train to
