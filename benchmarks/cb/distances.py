@@ -1,0 +1,38 @@
+"""Distance-matrix benchmarks (reference benchmarks/2020/distance_matrix/config.json:
+cdist strong/weak scaling on SUSY-sized row blocks; here the cb-suite form)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import heat_tpu as ht
+from benchmarks.cb.monitor import monitor
+
+N = int(os.environ.get("HEAT_TPU_BENCH_CDIST_N", "4096"))
+D = int(os.environ.get("HEAT_TPU_BENCH_CDIST_D", "18"))  # SUSY feature count
+
+
+def _xy():
+    ht.random.seed(7)
+    x = ht.random.randn(N, D, split=0)
+    y = ht.random.randn(N, D, split=0)
+    return x, y
+
+
+@monitor("cdist_split0")
+def cdist_split0():
+    x, y = _xy()
+    return ht.spatial.cdist(x, y).larray
+
+
+@monitor("cdist_self")
+def cdist_self():
+    x, _ = _xy()
+    return ht.spatial.cdist(x).larray
+
+
+@monitor("cdist_quadratic_expansion")
+def cdist_quadratic():
+    x, y = _xy()
+    return ht.spatial.cdist(x, y, quadratic_expansion=True).larray

@@ -21,15 +21,34 @@ def monitor(name: Optional[str] = None):
 
 
 def run_all(filter_substring: Optional[str] = None) -> None:
+    """Run registered benchmarks; one JSON line each.
+
+    Set ``HEAT_TPU_PROFILE=<dir>`` to additionally capture a ``jax.profiler`` trace of
+    each timed run (SURVEY §5: the reference instruments with the perun monitor and
+    publishes to a dashboard; the TPU-native equivalent is an XLA profile you open in
+    TensorBoard/Perfetto)."""
+    import contextlib
+    import os
+
     import jax
 
+    profile_dir = os.environ.get("HEAT_TPU_PROFILE")
     for name, fn in _REGISTRY:
         if filter_substring and filter_substring not in name:
             continue
-        # warmup run compiles; timed run measures steady state
-        fn()
-        t0 = time.perf_counter()
-        out = fn()
-        jax.block_until_ready(out) if out is not None else None
-        elapsed = time.perf_counter() - t0
+        # warmup run compiles; drain it fully so the timed run (and any profiler
+        # trace) measures only steady state, not the queued warmup tail
+        warm = fn()
+        if warm is not None:
+            jax.block_until_ready(warm)
+        ctx = (
+            jax.profiler.trace(os.path.join(profile_dir, name))
+            if profile_dir
+            else contextlib.nullcontext()
+        )
+        with ctx:
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(out) if out is not None else None
+            elapsed = time.perf_counter() - t0
         print(json.dumps({"benchmark": name, "wall_s": round(elapsed, 4), "backend": jax.default_backend(), "devices": len(jax.devices())}))

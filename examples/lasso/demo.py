@@ -20,6 +20,8 @@ def main():
 
     # normalize (reference demo.py:28)
     X = X / ht.sqrt(ht.mean(X**2, axis=0))
+    # the estimator treats column 0 as the unpenalized intercept — prepend ones
+    X = ht.concatenate([ht.ones((X.gshape[0], 1), split=0), X], axis=1)
 
     estimator = lasso.Lasso(max_iter=100)
     lamda = np.logspace(0, 4, 10) / 10
@@ -29,6 +31,7 @@ def main():
         estimator.lam = float(la)
         estimator.fit(X, y)
         theta_list.append(estimator.theta.numpy().flatten())
+    # strip the intercept row, keeping only the 10 penalized feature paths
     theta_lasso = np.stack(theta_list).T[1:, :]
 
     nonzero = (np.abs(theta_lasso) > 1e-8).sum(axis=0)

@@ -21,6 +21,9 @@ import jax.numpy as jnp
 
 __all__ = ["fused_assign_update", "fused_assign_update_reference"]
 
+# one block size shared by the kernel launcher and the VMEM gate
+_DEFAULT_BLOCK_N = 1024
+
 
 def fused_assign_update_reference(
     xv: jax.Array, centers: jax.Array
@@ -95,7 +98,7 @@ def _kernel(nvalid_ref, x_ref, c_ref, labels_ref, sums_ref, counts_ref, sse_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
-def _fused_pallas(xv, centers, block_n: int = 1024, interpret: bool = False):
+def _fused_pallas(xv, centers, block_n: int = _DEFAULT_BLOCK_N, interpret: bool = False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -149,12 +152,13 @@ def fused_assign_update(
     """
     if not interpret and jax.default_backend() != "tpu":
         return fused_assign_update_reference(xv, centers)
-    if not _fits_vmem(xv.shape[1], centers.shape[0]):
+    # interpret mode has no Mosaic VMEM limit — only gate real compilations
+    if not interpret and not _fits_vmem(xv.shape[1], centers.shape[0], _DEFAULT_BLOCK_N):
         return fused_assign_update_reference(xv, centers)
     return _fused_pallas(xv, centers, interpret=interpret)
 
 
-def _fits_vmem(d: int, k: int, block_n: int = 1024, budget_bytes: int = 8 * 2**20) -> bool:
+def _fits_vmem(d: int, k: int, block_n: int = _DEFAULT_BLOCK_N, budget_bytes: int = 8 * 2**20) -> bool:
     """Conservative VMEM gate: the kernel keeps the (bn,d) x block, (k,d) centers +
     sums, the (bn,k) distance/one-hot tiles, and working copies resident; wide or
     many-cluster inputs must fall back to the jnp path instead of failing Mosaic

@@ -23,6 +23,7 @@ from ..dndarray import DNDarray
 from ..stride_tricks import sanitize_axis
 
 __all__ = [
+    "PARITY_PRECISION",
     "cross",
     "det",
     "dot",

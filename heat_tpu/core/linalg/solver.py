@@ -14,6 +14,9 @@ import numpy as np
 
 from functools import partial
 
+import jax
+import jax.numpy as jnp
+
 from .. import factories, types
 from ..dndarray import DNDarray
 from .basics import PARITY_PRECISION, norm, transpose
@@ -63,6 +66,73 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     return x
 
 
+def _lanczos_device(a, m: int, v_init=None):
+    """The whole Lanczos iteration as ONE jitted ``lax.fori_loop`` (the reference — and
+    the first TPU port — drove each of the ~5 ops per iteration from the host, which
+    costs hundreds of dispatch round-trips; on-device the m=30 run is one launch).
+
+    Returns ``(V_rows, T)`` with ``V_rows`` (m, n): row i is the i-th Lanczos vector.
+    Full reorthogonalization per step as two masked matvecs; a vanishing beta restarts
+    with a counter-derived random vector (reference ``solver.py:142-156``).
+    """
+    if v_init is None:
+        v_init = jnp.ones((a.shape[0],), a.dtype)
+    else:
+        v_init = v_init.astype(a.dtype)
+    return _lanczos_run(a, v_init, m)
+
+
+def _lanczos_run_impl(a, v0, m_):
+    n = a.shape[0]
+    dt = a.dtype
+    eps = jnp.asarray(1e-10, dt)
+    key = jax.random.key(17)
+
+    def reorth(V, vr, i):
+        # project out rows < i: two matvecs instead of a Python loop of dots
+        coef = jnp.einsum(
+            "mn,n->m", V, vr, precision=jax.lax.Precision.HIGHEST
+        ) * (jnp.arange(m_) < i)
+        return vr - jnp.einsum(
+            "mn,m->n", V, coef, precision=jax.lax.Precision.HIGHEST
+        )
+
+    def matvec(x):
+        return jnp.einsum("ij,j->i", a, x, precision=jax.lax.Precision.HIGHEST)
+
+    v = v0 / jnp.linalg.norm(v0)
+    w0 = matvec(v)
+    alpha0 = jnp.dot(w0, v, precision=jax.lax.Precision.HIGHEST)
+    V = jnp.zeros((m_, n), dt).at[0].set(v)
+    T = jnp.zeros((m_, m_), dt).at[0, 0].set(alpha0)
+    w = w0 - alpha0 * v
+
+    def body(i, carry):
+        V, T, w = carry
+        beta = jnp.linalg.norm(w)
+        good = beta > eps
+        restart = jax.random.normal(jax.random.fold_in(key, i), (n,), dt)
+        vr = jnp.where(good, w / jnp.where(good, beta, 1.0), restart)
+        vr = reorth(V, vr, i)
+        nrm = jnp.linalg.norm(vr)
+        vr = jnp.where(nrm > 0, vr / jnp.where(nrm > 0, nrm, 1.0), vr)
+        wn = matvec(vr)
+        alpha = jnp.dot(wn, vr, precision=jax.lax.Precision.HIGHEST)
+        beta_eff = jnp.where(good, beta, jnp.asarray(0.0, dt))
+        wn = wn - alpha * vr - beta_eff * V[i - 1]
+        V = V.at[i].set(vr)
+        T = T.at[i, i].set(alpha).at[i - 1, i].set(beta_eff).at[i, i - 1].set(beta_eff)
+        return V, T, wn
+
+    V, T, _ = jax.lax.fori_loop(1, m_, body, (V, T, w))
+    return V, T
+
+
+# module-level jit: repeated lanczos calls hit the trace cache (a closure-local jit
+# would re-trace and re-compile on every invocation)
+_lanczos_run = jax.jit(_lanczos_run_impl, static_argnames=("m_",))
+
+
 def lanczos(
     A: DNDarray,
     m: int,
@@ -84,51 +154,20 @@ def lanczos(
         v0 = v0.resplit(None)
     m = int(m)
 
-    T = factories.zeros((m, m), dtype=A.dtype if A.dtype is types.float64 else types.float32, comm=A.comm)
-    if A.split == 0:
-        v = factories.ones((n,), split=0, dtype=A.dtype, comm=A.comm) if v0 is None else v0
-    else:
-        v = factories.ones((n,), split=None, dtype=A.dtype, comm=A.comm) if v0 is None else v0
-    if v0 is None:
-        v = v / norm(v)
-    vr = v
+    out_dtype = A.dtype if A.dtype is types.float64 else types.float32
+    v_init = None if v0 is None else v0.larray
+    V_rows, T_val = _lanczos_device(
+        A.larray.astype(np.dtype(out_dtype.jax_type())), m, v_init
+    )
 
-    # first iteration
-    w = matmul(A, vr)
-    alpha = float(dot(w, vr).item())
-    w = w - alpha * vr
-    T[0, 0] = alpha
-    V = [vr]
-    for i in range(1, m):
-        beta = float(norm(w).item())
-        if abs(beta) < 1e-10:
-            # restart with a random orthogonalized vector (reference solver.py:142-156)
-            from .. import random as ht_random
+    from ..dndarray import DNDarray as _D
 
-            vr = ht_random.rand(n, dtype=v.dtype, split=v.split, comm=A.comm)
-            for vi in V:
-                vr = vr - dot(vi, vr) * vi
-            vr = vr / norm(vr)
-        else:
-            vr = w / beta
-            # full reorthogonalization for numerical stability (reference does the same
-            # via projections when it detects drift)
-            for vi in V:
-                vr = vr - dot(vi, vr) * vi
-            nrm = float(norm(vr).item())
-            if nrm > 0:
-                vr = vr / nrm
-        w = matmul(A, vr)
-        alpha = float(dot(w, vr).item())
-        w = w - alpha * vr - (beta if abs(beta) >= 1e-10 else 0.0) * V[i - 1]
-        T[i, i] = alpha
-        T[i - 1, i] = beta
-        T[i, i - 1] = beta
-        V.append(vr)
-
-    from ..manipulations import stack
-
-    V_dnd = transpose(stack(V, axis=0), None)
+    T = _D(
+        A.comm.shard(T_val, None), (m, m), out_dtype, None, A.device, A.comm, True
+    )
+    V_dnd = _D(
+        A.comm.shard(V_rows.T, None), (n, m), out_dtype, None, A.device, A.comm, True
+    )
     if V_out is not None:
         V_out.larray = V_out.comm.shard(V_dnd.larray.astype(V_out.larray.dtype), V_out.split)
         V_dnd = V_out

@@ -3,7 +3,8 @@
 etc. resolve to optax factories."""
 
 from .dp_optimizer import *
-from . import dp_optimizer, lr_scheduler
+from .utils import *
+from . import dp_optimizer, lr_scheduler, utils
 
 
 def __getattr__(name):

@@ -190,3 +190,82 @@ class TestRingCdist:
         d = ht.spatial.cdist(ht.array(x, split=None), ht.array(y, split=0))
         assert d.split == 1 or not ht.array(y, split=0).is_distributed()
         np.testing.assert_allclose(d.numpy(), self._ref_cdist(x, y), rtol=1e-3, atol=2e-3)
+
+
+class TestAliases:
+    def test_mpi_names(self):
+        x = jnp.arange(comm.size, dtype=jnp.float32)
+        out = smap(lambda v: comm.Allreduce(v), P(AX), P(AX))(x)
+        np.testing.assert_allclose(np.asarray(out), np.full(comm.size, x.sum()))
+        out = smap(lambda v: comm.Bcast(v, root=0), P(AX), P(AX))(x)
+        np.testing.assert_allclose(np.asarray(out), np.zeros(comm.size))
+        out = smap(lambda v: comm.Exscan(v), P(AX), P(AX))(jnp.ones(comm.size, jnp.float32))
+        np.testing.assert_allclose(np.asarray(out), np.arange(comm.size))
+
+    def test_allgather_axis1(self):
+        n = comm.size
+        x = jnp.arange(n * 2, dtype=jnp.float32).reshape(n, 2)
+        # each shard holds one row as a (2, 1) column; gathering along axis=1
+        # reassembles the transposed matrix identically on every shard
+        out = smap(
+            lambda v: comm.all_gather(v.T, axis=1)[None], P(AX, None), P(AX, None, None)
+        )(x)
+        for r in range(n):
+            np.testing.assert_allclose(np.asarray(out[r]), np.asarray(x).T)
+
+    def test_exscan_int(self):
+        x = jnp.full(comm.size, 2, dtype=jnp.int32)
+        out = smap(lambda v: comm.exscan(v), P(AX), P(AX))(x)
+        np.testing.assert_array_equal(np.asarray(out), 2 * np.arange(comm.size))
+
+
+class TestHierarchicalCollectives:
+    """Per-axis collectives on a 2-D (dcn, ici) mesh — the DASO substrate."""
+
+    @pytest.fixture
+    def hcomm(self):
+        if len(jax.devices()) < 4 or len(jax.devices()) % 2 != 0:
+            pytest.skip("needs an even device count >= 4")
+        return MeshCommunication.hierarchical(2)
+
+    def test_axis_scoped_psum(self, hcomm):
+        dcn, ici = hcomm.axis_names
+        n_nodes, node_size = hcomm.n_nodes, hcomm.node_size
+        x = jnp.arange(hcomm.size, dtype=jnp.float32).reshape(n_nodes, node_size)
+
+        def body(v):
+            return (
+                hcomm.psum(v, axis_name=ici),
+                hcomm.psum(v, axis_name=dcn),
+                hcomm.psum(v, axis_name=(dcn, ici)),
+            )
+
+        fast, slow, both = jax.shard_map(
+            body,
+            mesh=hcomm.mesh,
+            in_specs=P(dcn, ici),
+            out_specs=(P(dcn, ici), P(dcn, ici), P(dcn, ici)),
+        )(x)
+        xn = np.asarray(x)
+        # psum over ici: row sums replicated across the row
+        np.testing.assert_allclose(
+            np.asarray(fast), np.repeat(xn.sum(1, keepdims=True), node_size, 1)
+        )
+        # psum over dcn: column sums replicated down the column
+        np.testing.assert_allclose(
+            np.asarray(slow), np.repeat(xn.sum(0, keepdims=True), n_nodes, 0)
+        )
+        np.testing.assert_allclose(np.asarray(both), np.full_like(xn, xn.sum()))
+
+    def test_topology_properties(self, hcomm):
+        assert hcomm.is_hierarchical
+        assert hcomm.n_nodes == 2
+        assert hcomm.n_nodes * hcomm.node_size == hcomm.size
+        # a split dim shards over all axes jointly
+        spec = hcomm.spec(2, 0)
+        assert spec == P(hcomm.axis_names, None)
+
+    def test_hierarchical_dup(self, hcomm):
+        dup = hcomm.Split()
+        assert dup.is_hierarchical
+        assert dup.n_nodes == hcomm.n_nodes

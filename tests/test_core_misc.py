@@ -1,0 +1,130 @@
+"""Small-core-module tests: printing, device registry, memory, constants, base
+estimator API (reference heat/core/tests/test_printing.py, test_devices.py, etc.)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestPrinting(TestCase):
+    def tearDown(self):
+        ht.global_printing()
+        ht.set_printoptions(profile="default")
+
+    def test_repr_global(self):
+        x = ht.arange(6, split=0)
+        s = repr(x)
+        self.assertIn("DNDarray", s)
+        self.assertIn("split=0", s)
+        for v in range(6):
+            self.assertIn(str(v), s)
+
+    def test_repr_scalar_and_replicated(self):
+        self.assertIn("45", repr(ht.arange(10, split=0).sum()))
+        s = repr(ht.ones((2, 2)))
+        self.assertIn("split=None", s)
+
+    def test_local_printing(self):
+        ht.local_printing()
+        s = repr(ht.arange(self.world_size * 2, split=0))
+        self.assertIn("device", s)
+        ht.global_printing()
+
+    def test_summarization_threshold(self):
+        ht.set_printoptions(threshold=10, edgeitems=2)
+        s = repr(ht.arange(10_000, split=0))
+        self.assertIn("...", s)
+        self.assertLess(len(s), 2000)
+
+    def test_printoptions_profiles(self):
+        ht.set_printoptions(profile="short")
+        self.assertEqual(ht.get_printoptions()["precision"], 2)
+        ht.set_printoptions(profile="full")
+        self.assertEqual(ht.get_printoptions()["threshold"], np.inf)
+        ht.set_printoptions(precision=7)
+        self.assertEqual(ht.get_printoptions()["precision"], 7)
+
+    def test_print0(self):
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            ht.print0("hello", 42)
+        self.assertEqual(buf.getvalue().strip(), "hello 42")
+
+
+class TestDevices(TestCase):
+    def test_registry(self):
+        d = ht.get_device()
+        self.assertIsInstance(d, ht.Device)
+        self.assertEqual(ht.sanitize_device(None), d)
+        self.assertEqual(ht.sanitize_device(str(d)).device_type, d.device_type)
+
+    def test_use_device_roundtrip(self):
+        original = ht.get_device()
+        try:
+            ht.use_device(original)
+            self.assertEqual(ht.get_device(), original)
+        finally:
+            ht.use_device(original)
+
+    def test_device_equality_hash(self):
+        a = ht.Device("cpu", 0)
+        b = ht.Device("cpu", 0)
+        c = ht.Device("cpu", 1)
+        self.assertEqual(a, b)
+        self.assertNotEqual(a, c)
+        self.assertEqual(hash(a), hash(b))
+        self.assertIn("cpu", repr(a))
+
+    def test_bad_device(self):
+        with self.assertRaises((ValueError, TypeError)):
+            ht.sanitize_device(42)
+
+
+class TestMemory(TestCase):
+    def test_copy_independent(self):
+        x = ht.arange(5, dtype=ht.float32, split=0)
+        y = ht.copy(x)
+        y[0] = 99.0
+        self.assertEqual(float(x[0]), 0.0)
+        self.assertEqual(float(y[0]), 99.0)
+        self.assertEqual(y.split, x.split)
+
+    def test_sanitize_memory_layout(self):
+        x = ht.ones((2, 3))
+        self.assertIs(ht.sanitize_memory_layout(x, "C"), x)
+
+
+class TestConstants(TestCase):
+    def test_values(self):
+        self.assertAlmostEqual(ht.pi, np.pi)
+        self.assertAlmostEqual(ht.e, np.e)
+        self.assertTrue(np.isinf(ht.inf))
+        self.assertTrue(np.isnan(ht.nan))
+
+
+class TestBaseEstimator(TestCase):
+    def test_get_set_params(self):
+        km = ht.cluster.KMeans(n_clusters=5, max_iter=7)
+        params = km.get_params()
+        self.assertEqual(params["n_clusters"], 5)
+        self.assertEqual(params["max_iter"], 7)
+        km.set_params(n_clusters=3)
+        self.assertEqual(km.n_clusters, 3)
+        with self.assertRaises(ValueError):
+            km.set_params(bogus_param=1)
+        self.assertIn("KMeans", repr(km))
+
+    def test_clone_via_params(self):
+        scaler = ht.preprocessing.StandardScaler(copy=False)
+        clone = type(scaler)(**scaler.get_params())
+        self.assertEqual(clone.get_params(), scaler.get_params())
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -203,3 +203,48 @@ class TestDASOSync:
         for _ in range(4):
             daso.epoch_loss_logic(1.0)  # perfectly stable loss
         assert daso.global_skip < 8
+
+
+class TestDetectMetricPlateau:
+    """reference heat/optim/utils.py:14 — plateau trigger semantics."""
+
+    def test_min_mode_plateau(self):
+        det = ht.optim.DetectMetricPlateau(mode="min", patience=2)
+        # improving stream: never a plateau
+        for v in (10.0, 9.0, 8.0, 7.0):
+            assert not det.test_if_improving(v)
+        # stalls: patience=2 tolerates two bad epochs, flags on the third
+        assert not det.test_if_improving(7.0)
+        assert not det.test_if_improving(7.0)
+        assert det.test_if_improving(7.0)
+        # counter reset after detection
+        assert not det.test_if_improving(7.0)
+
+    def test_max_mode_and_threshold(self):
+        det = ht.optim.DetectMetricPlateau(
+            mode="max", patience=0, threshold=0.5, threshold_mode="abs"
+        )
+        assert not det.test_if_improving(1.0)
+        assert not det.test_if_improving(2.0)  # +1.0 > abs threshold: improving
+        assert det.test_if_improving(2.2)  # +0.2 below threshold: plateau
+
+    def test_cooldown_and_state_roundtrip(self):
+        det = ht.optim.DetectMetricPlateau(mode="min", patience=0, cooldown=2)
+        assert not det.test_if_improving(5.0)
+        assert det.test_if_improving(5.0)  # plateau, enters cooldown
+        assert not det.test_if_improving(5.0)  # cooldown swallows bad epochs
+        state = det.get_state()
+        det2 = ht.optim.DetectMetricPlateau()
+        det2.set_state(state)
+        assert det2.cooldown_counter == det.cooldown_counter
+        assert det2.best == det.best
+        det.reset()
+        assert det.num_bad_epochs == 0 and det.best == np.inf
+
+    def test_errors(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            ht.optim.DetectMetricPlateau(mode="bogus")
+        with pytest.raises(ValueError):
+            ht.optim.DetectMetricPlateau(threshold_mode="bogus")

@@ -67,6 +67,54 @@ class TestSparse(TestCase):
         np.testing.assert_allclose(d.numpy(), a, rtol=1e-6)
         self.assert_array_equal(s.todense(), a)
 
+    def test_union_keeps_explicit_zeros(self):
+        """Sparse−sparse results keep the union pattern without pruning explicit
+        zeros (torch/scipy CSR semantics; the reference never drops result zeros)."""
+        a = np.array([[1.0, 0.0], [2.0, 3.0]], np.float32)
+        b = np.array([[-1.0, 5.0], [0.0, -3.0]], np.float32)
+        sa = ht.sparse.sparse_csr_matrix(ht.array(a), split=0)
+        sb = ht.sparse.sparse_csr_matrix(ht.array(b), split=0)
+        s = ht.sparse.add(sa, sb)
+        # values cancel at (0,0) and (1,1) but the union pattern keeps 4 stored
+        # slots — torch.sparse semantics (the reference's backend); scipy's `+`
+        # would prune the cancelled entries
+        np.testing.assert_allclose(s.numpy(), a + b, rtol=1e-6)
+        self.assertEqual(s.nnz, 4)
+
+    def test_large_random_vs_scipy(self):
+        try:
+            from scipy import sparse as sp
+        except ImportError:
+            self.skipTest("scipy not available")
+        a, b = _sample(8, (50, 40), 0.1), _sample(9, (50, 40), 0.1)
+        sa = ht.sparse.sparse_csr_matrix(ht.array(a), split=0)
+        sb = ht.sparse.sparse_csr_matrix(ht.array(b), split=0)
+        for ht_fn, sp_res in (
+            (ht.sparse.add, sp.csr_matrix(a) + sp.csr_matrix(b)),
+            (ht.sparse.mul, sp.csr_matrix(a).multiply(sp.csr_matrix(b)).tocsr()),
+        ):
+            got = ht_fn(sa, sb)
+            np.testing.assert_allclose(got.numpy(), sp_res.toarray(), rtol=1e-6)
+
+    def test_ragged_rows_split(self):
+        """Row counts that do not divide the mesh still produce correct CSR views."""
+        a = _sample(10, (self.world_size * 2 + 1, 5), 0.4)
+        s = ht.sparse.sparse_csr_matrix(ht.array(a, split=0), split=0)
+        np.testing.assert_allclose(s.numpy(), a, rtol=1e-6)
+        self.assertEqual(s.nnz, int((a != 0).sum()))
+        indptr = np.asarray(s.indptr)
+        self.assertEqual(len(indptr), a.shape[0] + 1)
+        self.assertEqual(indptr[-1], s.nnz)
+
+    def test_round_trip_preserves_dtype_and_shape(self):
+        for dt in (ht.float32, ht.float64):
+            a = _sample(11).astype(np.dtype(dt.jax_type()))
+            s = ht.sparse.sparse_csr_matrix(ht.array(a, split=0), split=0)
+            self.assertIs(s.dtype, dt)
+            back = ht.sparse.to_dense(s)
+            self.assertIs(back.dtype, dt)
+            np.testing.assert_allclose(back.numpy(), a, rtol=1e-6)
+
     def test_astype_and_errors(self):
         a = _sample(6)
         s = ht.sparse.sparse_csr_matrix(ht.array(a))
