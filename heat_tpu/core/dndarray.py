@@ -243,6 +243,16 @@ class DNDarray:
     def create_lshape_map(self, force_check: bool = False) -> "DNDarray":
         return self.lshape_map(force_check)
 
+    def counts_displs(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        """Per-shard counts and offsets along the split axis (reference
+        ``dndarray.py:626``)."""
+        if self.__split is None:
+            raise ValueError(
+                "Non-distributed DNDarray. Cannot calculate counts and displacements."
+            )
+        counts, displs, _ = self.__comm.counts_displs_shape(self.__gshape, self.__split)
+        return counts, displs
+
     def is_balanced(self, force_check: bool = False) -> bool:
         """Canonical XLA layouts are balanced by construction (reference ``:466``)."""
         return True

@@ -22,7 +22,17 @@ from .communication import sanitize_comm
 from .devices import sanitize_device
 from .dndarray import DNDarray
 
-__all__ = ["load", "load_csv", "load_npy", "save_csv", "save_npy", "save", "supports_hdf5", "supports_netcdf"]
+__all__ = [
+    "load",
+    "load_csv",
+    "load_npy",
+    "save_csv",
+    "save_npy",
+    "save",
+    "supports_hdf5",
+    "supports_netcdf",
+    "supports_zarr",
+]
 
 try:
     import h5py
@@ -37,6 +47,13 @@ try:
     _HAS_NETCDF = True
 except ImportError:
     _HAS_NETCDF = False
+
+try:
+    import tensorstore as _ts
+
+    _HAS_ZARR = True
+except ImportError:
+    _HAS_ZARR = False
 
 
 def _is_writer() -> bool:
@@ -85,6 +102,82 @@ def supports_hdf5() -> bool:
 def supports_netcdf() -> bool:
     """True if NetCDF I/O is available (reference ``io.py:50``)."""
     return _HAS_NETCDF
+
+
+def supports_zarr() -> bool:
+    """True if the tensorstore-backed zarr path is available (SURVEY §7: the
+    TPU-native checkpoint/data store; no reference equivalent)."""
+    return _HAS_ZARR
+
+
+if _HAS_ZARR:
+    __all__.extend(["load_zarr", "save_zarr"])
+
+    def _zarr_spec(path: str) -> dict:
+        return {"driver": "zarr", "kvstore": {"driver": "file", "path": os.path.abspath(path)}}
+
+    def save_zarr(data: DNDarray, path: str, **kwargs) -> None:
+        """Write a DNDarray to a zarr store with chunking aligned to the shard grid —
+        every device buffer streams to its own chunk files, the cloud-native form of
+        the reference's per-rank HDF5 hyperslabs (``io.py:211-238``)."""
+        if not isinstance(data, DNDarray):
+            raise TypeError(f"data must be a DNDarray, not {type(data)}")
+        np_dtype = np.dtype(data.dtype.jax_type())
+        # chunk shape = the canonical FIRST shard chunk (identical on every rank,
+        # including ragged splits where later shards are smaller)
+        _, lshape, _ = data.comm.chunk(data.gshape, data.split, rank=0)
+        chunk_shape = [max(1, int(s)) for s in lshape]
+
+        def _open_store():
+            return _ts.open(
+                _zarr_spec(path),
+                create=True,
+                delete_existing=True,
+                dtype=_ts.dtype(np_dtype),
+                shape=list(data.gshape),
+                chunk_layout=_ts.ChunkLayout(chunk_shape=chunk_shape),
+            ).result()
+
+        if data.split is None or not data.larray.is_fully_addressable:
+            # multi-controller (or replicated): gather, single writer — only the
+            # writer may create/delete the store (see _is_writer)
+            value = data.numpy()
+            if _is_writer():
+                _open_store()[...] = value
+            return
+        store = _open_store()
+        futures = [
+            store[shard.index].write(np.asarray(shard.data))
+            for shard in data.larray.addressable_shards
+            if shard.index is not None
+        ]
+        for f in futures:
+            f.result()
+
+    def load_zarr(
+        path: str,
+        dtype=None,
+        split: Optional[int] = None,
+        device=None,
+        comm=None,
+    ) -> DNDarray:
+        """Load a zarr store; each process reads only its addressable shard chunks."""
+        comm = sanitize_comm(comm)
+        store = _ts.open(_zarr_spec(path)).result()
+        gshape = tuple(store.shape)
+        np_dtype = np.dtype(store.dtype.numpy_dtype) if dtype is None else np.dtype(
+            types.canonical_heat_type(dtype).jax_type()
+        )
+        if split is None or comm.size == 1:
+            arr = np.asarray(store.read().result(), dtype=np_dtype)
+            return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+
+        class _Reader:
+            def __getitem__(self, idx):
+                return np.asarray(store[idx].read().result(), dtype=np_dtype)
+
+        value = _sharded_read(_Reader(), gshape, np_dtype, split, comm)
+        return factories.array(value, dtype=dtype, split=split, device=device, comm=comm)
 
 
 if _HAS_HDF5:
@@ -324,6 +417,10 @@ def load(path: str, *args, **kwargs) -> DNDarray:
         return load_csv(path, *args, **kwargs)
     if extension == ".npy":
         return load_npy(path, *args, **kwargs)
+    if extension == ".zarr":
+        if not supports_zarr():
+            raise RuntimeError(f"tensorstore is required for file extension {extension}")
+        return load_zarr(path, *args, **kwargs)
     raise ValueError(f"unsupported file extension {extension}")
 
 
@@ -344,4 +441,8 @@ def save(data: DNDarray, path: str, *args, **kwargs) -> None:
         return save_csv(data, path, *args, **kwargs)
     if extension == ".npy":
         return save_npy(data, path)
+    if extension == ".zarr":
+        if not supports_zarr():
+            raise RuntimeError(f"tensorstore is required for file extension {extension}")
+        return save_zarr(data, path, *args, **kwargs)
     raise ValueError(f"unsupported file extension {extension}")

@@ -1,9 +1,10 @@
 """Iterative solvers (reference heat/core/linalg/solver.py, 272 LoC).
 
-``cg`` and ``lanczos`` are expressed entirely in DNDarray ops — matvecs, dots, norms —
-so every iteration is a handful of XLA programs whose cross-shard reductions become
-``psum`` on the mesh. The iteration control stays on host (data-dependent convergence),
-exactly like the reference's Python loop over MPI collectives.
+``cg`` and ``lanczos`` each compile to ONE jitted program — matvec, line search /
+reorthogonalization, and the convergence test all run on device inside
+``lax.while_loop``/``fori_loop`` (the reference drives every iteration from the host
+over MPI collectives; a host loop costs one dispatch round-trip per op). Cross-shard
+reductions become ``psum`` on the mesh via XLA's partitioner.
 """
 
 from __future__ import annotations
@@ -12,22 +13,13 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
 from .. import factories, types
 from ..dndarray import DNDarray
-from .basics import PARITY_PRECISION, norm, transpose
-from .basics import dot as _dot
-from .basics import matmul as _matmul
 
 __all__ = ["cg", "lanczos"]
-
-# iterative solvers accumulate rounding across iterations: full fp32 matvecs/dots
-matmul = partial(_matmul, precision=PARITY_PRECISION)
-dot = partial(_dot, precision=PARITY_PRECISION)
 
 
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
@@ -41,29 +33,47 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     if x0.ndim != 1:
         raise RuntimeError("x0 needs to be a 1D vector")
 
-    r = b - matmul(A, x0)
-    p = r
-    rsold = dot(r, r)
-    x = x0
-
-    for _ in range(len(b)):
-        Ap = matmul(A, p)
-        alpha = rsold / dot(p, Ap)
-        x = x + alpha * p
-        r = r - alpha * Ap
-        rsnew = dot(r, r)
-        if float(rsnew.item() if isinstance(rsnew, DNDarray) else rsnew) ** 0.5 < 1e-10:
-            if out is not None:
-                out.larray = out.comm.shard(x.larray.astype(out.larray.dtype), out.split)
-                return out
-            return x
-        p = r + (rsnew / rsold) * p
-        rsold = rsnew
-
+    # the whole iteration (matvec, line search, convergence) is one jitted
+    # lax.while_loop — the reference's host loop syncs once per iteration
+    x_val = _cg_run(
+        A.larray.astype(b.larray.dtype), b.larray, x0.larray.astype(b.larray.dtype)
+    )
+    x = factories.array(x_val, split=b.split, device=b.device, comm=b.comm)
     if out is not None:
         out.larray = out.comm.shard(x.larray.astype(out.larray.dtype), out.split)
         return out
     return x
+
+
+def _cg_run_impl(a, b, x0):
+    hp = jax.lax.Precision.HIGHEST
+
+    def mv(v):
+        return jnp.einsum("ij,j->i", a, v, precision=hp)
+
+    r0 = b - mv(x0)
+    state0 = (x0, r0, r0, jnp.dot(r0, r0, precision=hp), jnp.int32(0))
+    n = b.shape[0]
+
+    def cond(state):
+        _, _, _, rsold, it = state
+        return jnp.logical_and(it < n, jnp.sqrt(rsold) >= 1e-10)
+
+    def body(state):
+        x, r, p, rsold, it = state
+        Ap = mv(p)
+        alpha = rsold / jnp.dot(p, Ap, precision=hp)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rsnew = jnp.dot(r, r, precision=hp)
+        p = r + (rsnew / rsold) * p
+        return x, r, p, rsnew, it + 1
+
+    x, _, _, _, _ = jax.lax.while_loop(cond, body, state0)
+    return x
+
+
+_cg_run = jax.jit(_cg_run_impl)
 
 
 def _lanczos_device(a, m: int, v_init=None):
@@ -160,13 +170,9 @@ def lanczos(
         A.larray.astype(np.dtype(out_dtype.jax_type())), m, v_init
     )
 
-    from ..dndarray import DNDarray as _D
-
-    T = _D(
-        A.comm.shard(T_val, None), (m, m), out_dtype, None, A.device, A.comm, True
-    )
-    V_dnd = _D(
-        A.comm.shard(V_rows.T, None), (n, m), out_dtype, None, A.device, A.comm, True
+    T = factories.array(T_val, dtype=out_dtype, split=None, device=A.device, comm=A.comm)
+    V_dnd = factories.array(
+        V_rows.T, dtype=out_dtype, split=None, device=A.device, comm=A.comm
     )
     if V_out is not None:
         V_out.larray = V_out.comm.shard(V_dnd.larray.astype(V_out.larray.dtype), V_out.split)

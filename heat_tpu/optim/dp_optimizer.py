@@ -208,6 +208,32 @@ class DASO:
         self._sync_fn = None
         self._model_params_stale = False
 
+    def add_scaler(self, scaler) -> None:
+        """Accepted for API parity (reference ``:256`` attaches a torch AMP
+        GradScaler); bf16 on TPU needs no loss scaling, so this is a stored no-op."""
+        self.scaler = scaler
+
+    def set_model(self, model) -> None:
+        """Attach the model whose parameters DASO replicates (reference ``:725``;
+        normally done by ``DataParallelMultiGPU``). Routes through the local
+        optimizer's attach so its optimizer state re-initializes for the new
+        parameters."""
+        self.local_optimizer._attach(model)
+        self._stacked_params = None
+        self._stacked_opt_state = None
+
+    def reset(self) -> None:
+        """Reset the phase machine to its base state (reference ``:711``)."""
+        self.global_skip = 0
+        self.local_skip = 0
+        self.batches_to_wait = 0
+        self.epoch = 0
+        self._batch_in_epoch = 0
+        self._prev_losses = []
+        self._phase = "warmup"
+        if self.warmup_epochs == 0:
+            self._start_cycling()
+
     # ------------------------------------------------------------------ phase machine
     def _start_cycling(self) -> None:
         self._phase = "cycling"

@@ -105,6 +105,27 @@ class DCSR_matrix:
         lo, hi = slices[0].start or 0, slices[0].stop
         return int(np.sum((rows >= lo) & (rows < hi))) if self.__split == 0 else self.__gnnz
 
+    def is_distributed(self) -> bool:
+        """True when the rows live on more than one device (reference
+        ``dcsr_matrix.py:271``)."""
+        return self.__split is not None and self.__comm.size > 1
+
+    def counts_displs_nnz(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        """Per-shard stored-value counts and offsets (reference
+        ``dcsr_matrix.py:277``)."""
+        if self.__split is None:
+            raise ValueError(
+                "Non-distributed DCSR_matrix. Cannot calculate counts and displacements."
+            )
+        rows = self._coo_rows()
+        counts = []
+        for r in range(self.__comm.size):
+            _, _, slices = self.__comm.chunk(self.__gshape, self.__split, rank=r)
+            lo, hi = slices[0].start or 0, slices[0].stop
+            counts.append(int(np.sum((rows >= lo) & (rows < hi))))
+        displs = [0] + [int(v) for v in np.cumsum(counts[:-1])]
+        return tuple(counts), tuple(displs)
+
     # ------------------------------------------------------------------ CSR views
     def _coo_rows(self) -> np.ndarray:
         return np.asarray(self.__array.indices[:, 0])

@@ -248,3 +248,20 @@ class TestDetectMetricPlateau:
             ht.optim.DetectMetricPlateau(mode="bogus")
         with pytest.raises(ValueError):
             ht.optim.DetectMetricPlateau(threshold_mode="bogus")
+
+
+class TestDASOPublicAPI:
+    @needs_4
+    def test_reset_and_set_model(self):
+        daso, model, loss_fn = _make_daso(warmup_epochs=0, max_global_skips=8)
+        for _ in range(2):
+            daso.epoch_end()
+        assert daso.epoch == 2
+        daso.reset()
+        assert daso.epoch == 0 and daso._batch_in_epoch == 0
+        assert daso._phase == "cycling"  # warmup_epochs=0 goes straight to cycling
+        daso.add_scaler("amp-scaler-placeholder")
+        assert daso.scaler == "amp-scaler-placeholder"
+        # set_model rebinds and clears the replica stack
+        daso.set_model(model)
+        assert daso.stacked_params is None

@@ -106,6 +106,16 @@ class TestDNDarray(TestCase):
         vals = [int(v) for v in x]
         self.assertEqual(vals, [0, 1, 2, 3, 4])
 
+    def test_counts_displs(self):
+        x = ht.zeros((self.world_size * 2 + 1, 3), split=0)
+        counts, displs = x.counts_displs()
+        self.assertEqual(sum(counts), self.world_size * 2 + 1)
+        self.assertEqual(displs[0], 0)
+        for i in range(1, len(displs)):
+            self.assertEqual(displs[i], displs[i - 1] + counts[i - 1])
+        with self.assertRaises(ValueError):
+            ht.zeros((4,)).counts_displs()
+
     def test_halo(self):
         n = max(8, self.world_size * 2)
         np_x = np.arange(n * 3).reshape(n, 3).astype(np.float32)

@@ -115,6 +115,18 @@ class TestSparse(TestCase):
             self.assertIs(back.dtype, dt)
             np.testing.assert_allclose(back.numpy(), a, rtol=1e-6)
 
+    def test_counts_displs_nnz(self):
+        a = _sample(12, (self.world_size * 2, 5), 0.4)
+        s = ht.sparse.sparse_csr_matrix(ht.array(a, split=0), split=0)
+        self.assertEqual(s.is_distributed(), self.world_size > 1)
+        counts, displs = s.counts_displs_nnz()
+        self.assertEqual(sum(counts), s.nnz)
+        self.assertEqual(displs[0], 0)
+        for i in range(1, len(displs)):
+            self.assertEqual(displs[i], displs[i - 1] + counts[i - 1])
+        with self.assertRaises(ValueError):
+            ht.sparse.sparse_csr_matrix(ht.array(a)).counts_displs_nnz()
+
     def test_astype_and_errors(self):
         a = _sample(6)
         s = ht.sparse.sparse_csr_matrix(ht.array(a))
