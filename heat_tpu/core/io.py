@@ -116,10 +116,12 @@ if _HAS_ZARR:
     def _zarr_spec(path: str) -> dict:
         return {"driver": "zarr", "kvstore": {"driver": "file", "path": os.path.abspath(path)}}
 
-    def save_zarr(data: DNDarray, path: str, **kwargs) -> None:
+    def save_zarr(data: DNDarray, path: str) -> None:
         """Write a DNDarray to a zarr store with chunking aligned to the shard grid —
         every device buffer streams to its own chunk files, the cloud-native form of
-        the reference's per-rank HDF5 hyperslabs (``io.py:211-238``)."""
+        the reference's per-rank HDF5 hyperslabs (``io.py:211-238``). Under
+        multi-controller, process 0 creates the store, then every process writes its
+        own addressable chunks concurrently (chunk-aligned writes need no locking)."""
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
         np_dtype = np.dtype(data.dtype.jax_type())
@@ -128,7 +130,7 @@ if _HAS_ZARR:
         _, lshape, _ = data.comm.chunk(data.gshape, data.split, rank=0)
         chunk_shape = [max(1, int(s)) for s in lshape]
 
-        def _open_store():
+        def _create_store():
             return _ts.open(
                 _zarr_spec(path),
                 create=True,
@@ -138,14 +140,22 @@ if _HAS_ZARR:
                 chunk_layout=_ts.ChunkLayout(chunk_shape=chunk_shape),
             ).result()
 
-        if data.split is None or not data.larray.is_fully_addressable:
-            # multi-controller (or replicated): gather, single writer — only the
-            # writer may create/delete the store (see _is_writer)
+        if data.split is None:
             value = data.numpy()
             if _is_writer():
-                _open_store()[...] = value
+                _create_store()[...] = value
             return
-        store = _open_store()
+        if data.larray.is_fully_addressable:
+            store = _create_store()
+        else:
+            # multi-controller: only process 0 creates/deletes; everyone then opens
+            # the existing store and streams its own shard chunks
+            from jax.experimental import multihost_utils
+
+            if _is_writer():
+                _create_store()
+            multihost_utils.sync_global_devices(f"heat_tpu.save_zarr:{path}")
+            store = _ts.open(_zarr_spec(path)).result()
         futures = [
             store[shard.index].write(np.asarray(shard.data))
             for shard in data.larray.addressable_shards

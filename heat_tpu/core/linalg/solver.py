@@ -34,10 +34,14 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
         raise RuntimeError("x0 needs to be a 1D vector")
 
     # the whole iteration (matvec, line search, convergence) is one jitted
-    # lax.while_loop — the reference's host loop syncs once per iteration
-    x_val = _cg_run(
-        A.larray.astype(b.larray.dtype), b.larray, x0.larray.astype(b.larray.dtype)
-    )
+    # lax.while_loop — the reference's host loop syncs once per iteration.
+    # Promote to the widest operand dtype (at least f32) like the old DNDarray-op
+    # path did; a silent downcast would also leave the 1e-10 tolerance unreachable.
+    dt = types.promote_types(
+        types.promote_types(A.dtype, b.dtype),
+        types.promote_types(x0.dtype, types.float32),
+    ).jax_type()
+    x_val = _cg_run(A.larray.astype(dt), b.larray.astype(dt), x0.larray.astype(dt))
     x = factories.array(x_val, split=b.split, device=b.device, comm=b.comm)
     if out is not None:
         out.larray = out.comm.shard(x.larray.astype(out.larray.dtype), out.split)

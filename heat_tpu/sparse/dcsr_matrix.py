@@ -97,13 +97,20 @@ class DCSR_matrix:
 
     gnnz = nnz
 
+    def _rank_nnz(self, rank: int) -> int:
+        """Stored values inside ``rank``'s row chunk (the one chunk-count idiom shared
+        by ``lnnz`` and ``counts_displs_nnz``)."""
+        rows = self._coo_rows()
+        _, _, slices = self.__comm.chunk(self.__gshape, self.__split, rank=rank)
+        lo, hi = slices[0].start or 0, slices[0].stop
+        return int(np.sum((rows >= lo) & (rows < hi)))
+
     @property
     def lnnz(self) -> int:
         """Stored values in this rank's row chunk (reference ``dcsr_matrix.py:230``)."""
-        rows = self._coo_rows()
-        _, _, slices = self.__comm.chunk(self.__gshape, self.__split)
-        lo, hi = slices[0].start or 0, slices[0].stop
-        return int(np.sum((rows >= lo) & (rows < hi))) if self.__split == 0 else self.__gnnz
+        if self.__split != 0:
+            return self.__gnnz
+        return self._rank_nnz(self.__comm.rank)
 
     def is_distributed(self) -> bool:
         """True when the rows live on more than one device (reference
@@ -117,12 +124,7 @@ class DCSR_matrix:
             raise ValueError(
                 "Non-distributed DCSR_matrix. Cannot calculate counts and displacements."
             )
-        rows = self._coo_rows()
-        counts = []
-        for r in range(self.__comm.size):
-            _, _, slices = self.__comm.chunk(self.__gshape, self.__split, rank=r)
-            lo, hi = slices[0].start or 0, slices[0].stop
-            counts.append(int(np.sum((rows >= lo) & (rows < hi))))
+        counts = [self._rank_nnz(r) for r in range(self.__comm.size)]
         displs = [0] + [int(v) for v in np.cumsum(counts[:-1])]
         return tuple(counts), tuple(displs)
 

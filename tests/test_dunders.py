@@ -1,0 +1,100 @@
+"""Operator-protocol coverage: arithmetic/comparison/in-place dunders across splits
+(reference exercises these throughout test_arithmetics.py's 4,519 LoC; here as a
+dense sweep)."""
+
+import operator
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+
+class TestBinaryDunders(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(0)
+        self.a = (rng.random((5, 6)) + 0.5).astype(np.float32)
+        self.b = (rng.random((5, 6)) + 0.5).astype(np.float32)
+
+    def _sweep(self, op):
+        expected = op(self.a, self.b)
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                got = op(ht.array(self.a, split=sa), ht.array(self.b, split=sb))
+                np.testing.assert_allclose(
+                    got.numpy(), expected, rtol=1e-5, err_msg=f"{op.__name__} {sa},{sb}"
+                )
+
+    def test_arithmetic(self):
+        for op in (operator.add, operator.sub, operator.mul, operator.truediv,
+                   operator.pow, operator.mod, operator.floordiv):
+            self._sweep(op)
+
+    def test_matmul_operator(self):
+        m1 = self.a
+        m2 = self.b.T.copy()
+        expected = m1 @ m2
+        for sa in (None, 0, 1):
+            got = ht.array(m1, split=sa) @ ht.array(m2, split=sa)
+            np.testing.assert_allclose(got.numpy(), expected, rtol=1e-4, atol=1e-5)
+
+    def test_comparisons(self):
+        for op in (operator.eq, operator.ne, operator.lt, operator.le,
+                   operator.gt, operator.ge):
+            self._sweep(op)
+
+    def test_reflected_scalars(self):
+        x = ht.array(self.a, split=0)
+        np.testing.assert_allclose((2.0 + x).numpy(), 2.0 + self.a, rtol=1e-6)
+        np.testing.assert_allclose((2.0 - x).numpy(), 2.0 - self.a, rtol=1e-6)
+        np.testing.assert_allclose((2.0 * x).numpy(), 2.0 * self.a, rtol=1e-6)
+        np.testing.assert_allclose((2.0 / x).numpy(), 2.0 / self.a, rtol=1e-5)
+        np.testing.assert_allclose((2.0 ** x).numpy(), 2.0 ** self.a, rtol=1e-5)
+
+    def test_unary(self):
+        for split in (None, 0, 1):
+            x = ht.array(self.a, split=split)
+            np.testing.assert_allclose((-x).numpy(), -self.a, rtol=1e-6)
+            np.testing.assert_allclose((+x).numpy(), self.a, rtol=1e-6)
+            np.testing.assert_allclose(abs(-x).numpy(), self.a, rtol=1e-6)
+
+    def test_int_bitwise(self):
+        ia = np.arange(12, dtype=np.int32).reshape(3, 4)
+        ib = (np.arange(12, dtype=np.int32).reshape(3, 4) % 5) + 1
+        for op in (operator.and_, operator.or_, operator.xor,
+                   operator.lshift, operator.rshift):
+            expected = op(ia, ib)
+            got = op(ht.array(ia, split=0), ht.array(ib, split=0))
+            np.testing.assert_array_equal(got.numpy(), expected)
+        np.testing.assert_array_equal((~ht.array(ia, split=1)).numpy(), ~ia)
+
+
+class TestInplaceDunders(TestCase):
+    def test_inplace_ops_rebind(self):
+        base = np.arange(12, dtype=np.float32).reshape(3, 4) + 1.0
+        for split in (None, 0, 1):
+            x = ht.array(base.copy(), split=split)
+            ref = base.copy()
+            x += 2.0
+            ref += 2.0
+            x *= 3.0
+            ref *= 3.0
+            x -= 1.5
+            ref -= 1.5
+            x /= 2.0
+            ref /= 2.0
+            np.testing.assert_allclose(x.numpy(), ref, rtol=1e-6)
+            self.assertEqual(x.split, split)
+
+    def test_inplace_with_array_other(self):
+        a = np.ones((4, 3), np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a.copy(), split=split)
+            x += ht.arange(3, dtype=ht.float32)  # broadcast in-place
+            np.testing.assert_allclose(x.numpy(), a + np.arange(3), rtol=1e-6)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

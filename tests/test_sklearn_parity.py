@@ -1,0 +1,154 @@
+"""Estimator parity vs scikit-learn (the reference models its estimator API and
+semantics on sklearn; these tests pin the numerics to the canonical implementation
+across every split)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase
+
+sklearn = pytest.importorskip("sklearn")
+
+
+def _blobs(n=120, d=5, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 5, (classes, d)).astype(np.float32)
+    y = rng.integers(0, classes, n)
+    x = centers[y] + rng.normal(0, 0.8, (n, d)).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.int64)
+
+
+class TestKNNParity(TestCase):
+    def test_predictions_match(self):
+        from sklearn.neighbors import KNeighborsClassifier as SkKNN
+
+        x, y = _blobs()
+        xt, yt = x[:90], y[:90]
+        xq = x[90:]
+        sk = SkKNN(n_neighbors=5).fit(xt, yt)
+        expected = sk.predict(xq)
+        for split in (None, 0):
+            knn = ht.classification.kneighborsclassifier.KNeighborsClassifier(n_neighbors=5)
+            knn.fit(ht.array(xt, split=split), ht.array(yt, split=split))
+            got = knn.predict(ht.array(xq, split=split)).numpy().ravel()
+            # well-separated blobs: identical labels
+            np.testing.assert_array_equal(got, expected)
+
+
+class TestGaussianNBParity(TestCase):
+    def test_statistics_and_predictions(self):
+        from sklearn.naive_bayes import GaussianNB as SkNB
+
+        x, y = _blobs(seed=1)
+        sk = SkNB().fit(x, y)
+        for split in (None, 0):
+            nb = ht.naive_bayes.GaussianNB()
+            nb.fit(ht.array(x, split=split), ht.array(y, split=split))
+            np.testing.assert_allclose(np.asarray(nb.theta_), sk.theta_, rtol=1e-4)
+            np.testing.assert_allclose(np.asarray(nb.var_), sk.var_, rtol=1e-3, atol=1e-5)
+            np.testing.assert_array_equal(
+                nb.predict(ht.array(x, split=split)).numpy().ravel(), sk.predict(x)
+            )
+
+    def test_partial_fit_parity(self):
+        from sklearn.naive_bayes import GaussianNB as SkNB
+
+        x, y = _blobs(seed=2)
+        classes = np.unique(y)
+        sk = SkNB()
+        sk.partial_fit(x[:60], y[:60], classes=classes)
+        sk.partial_fit(x[60:], y[60:])
+        nb = ht.naive_bayes.GaussianNB()
+        nb.partial_fit(ht.array(x[:60], split=0), ht.array(y[:60], split=0), classes=ht.array(classes))
+        nb.partial_fit(ht.array(x[60:], split=0), ht.array(y[60:], split=0))
+        np.testing.assert_allclose(np.asarray(nb.theta_), sk.theta_, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(nb.var_), sk.var_, rtol=1e-3, atol=1e-5)
+
+
+class TestScalerParity(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(3)
+        self.x = (rng.random((40, 6)) * 100 - 50).astype(np.float32)
+
+    def _check(self, ht_cls, sk_obj, **kw):
+        from numpy.testing import assert_allclose
+
+        expected = sk_obj.fit_transform(self.x)
+        for split in (None, 0):
+            scaler = ht_cls(**kw)
+            hx = ht.array(self.x, split=split)
+            got = scaler.fit_transform(hx)
+            assert_allclose(got.numpy(), expected, rtol=1e-4, atol=1e-4,
+                            err_msg=f"{ht_cls.__name__} split={split}")
+            # inverse round-trip
+            back = scaler.inverse_transform(got)
+            assert_allclose(back.numpy(), self.x, rtol=1e-3, atol=1e-3)
+
+    def test_standard(self):
+        from sklearn.preprocessing import StandardScaler
+
+        self._check(ht.preprocessing.StandardScaler, StandardScaler())
+
+    def test_minmax(self):
+        from sklearn.preprocessing import MinMaxScaler
+
+        self._check(ht.preprocessing.MinMaxScaler, MinMaxScaler())
+
+    def test_maxabs(self):
+        from sklearn.preprocessing import MaxAbsScaler
+
+        self._check(ht.preprocessing.MaxAbsScaler, MaxAbsScaler())
+
+    def test_robust(self):
+        from sklearn.preprocessing import RobustScaler
+
+        self._check(ht.preprocessing.RobustScaler, RobustScaler())
+
+    def test_normalizer(self):
+        from sklearn.preprocessing import Normalizer
+
+        expected = Normalizer().fit_transform(self.x)
+        for split in (None, 0):
+            got = ht.preprocessing.Normalizer().fit_transform(ht.array(self.x, split=split))
+            np.testing.assert_allclose(got.numpy(), expected, rtol=1e-4)
+
+
+class TestKMeansParity(TestCase):
+    def test_inertia_comparable(self):
+        from sklearn.cluster import KMeans as SkKMeans
+
+        x, _ = _blobs(n=300, d=4, classes=4, seed=4)
+        sk = SkKMeans(n_clusters=4, n_init=5, random_state=0, max_iter=100).fit(x)
+        km = ht.cluster.KMeans(n_clusters=4, init="kmeans++", max_iter=100, random_state=0)
+        km.fit(ht.array(x, split=0))
+        # same data, both converged: inertia within 5% of sklearn's multi-init best
+        self.assertLessEqual(km.inertia_, sk.inertia_ * 1.05)
+
+    def test_lasso_vs_sklearn_shrinkage(self):
+        from sklearn.linear_model import Lasso as SkLasso
+
+        rng = np.random.default_rng(5)
+        n, d = 100, 8
+        X = rng.standard_normal((n, d)).astype(np.float64)
+        w = np.zeros(d)
+        w[:3] = (3.0, -2.0, 1.5)
+        yv = X @ w + 0.01 * rng.standard_normal(n)
+        lam = 0.1
+        # sklearn minimizes (1/2n)||y-Xw||² + α||w||₁; the coordinate-descent form
+        # here uses per-coordinate soft thresholding by lam on the correlation —
+        # match by scaling
+        sk = SkLasso(alpha=lam / n * np.sum(X[:, 0] ** 2) / 2, fit_intercept=True)
+        sk.fit(X, yv)
+        Xi = np.hstack([np.ones((n, 1)), X])
+        est = ht.regression.lasso.Lasso(lam=lam, max_iter=500, tol=1e-8)
+        est.fit(ht.array(Xi, split=0), ht.array(yv, split=0))
+        got = est.coef_.numpy().ravel()
+        # support recovery: the three true features dominate
+        self.assertEqual(set(np.argsort(-np.abs(got))[:3]), {0, 1, 2})
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
