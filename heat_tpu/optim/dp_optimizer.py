@@ -41,6 +41,39 @@ __all__ = ["DataParallelOptimizer", "DASO"]
 from ..nn.modules import _to_value
 
 
+def _loss_fn_cache_key(loss_fn, cache: dict):
+    """Cache key for a compiled step: (code, bound instance, defaults, captures).
+
+    A lambda or closure re-created each call with the same code and the same captured
+    objects reuses its compiled step instead of re-tracing forever. Captured values are
+    identified by object identity and treated as trace-time constants — mutating a
+    captured container in place does NOT retrace (the same contract jax.jit gives a
+    single function object); pass changing values as step arguments instead.
+    """
+    code = getattr(loss_fn, "__code__", None)
+    if code is None:
+        return loss_fn
+    closure = getattr(loss_fn, "__closure__", None) or ()
+    defaults = getattr(loss_fn, "__defaults__", None) or ()
+    kwdefaults = getattr(loss_fn, "__kwdefaults__", None) or {}
+    key = (
+        code,
+        id(getattr(loss_fn, "__self__", None)),
+        tuple(id(c.cell_contents) for c in closure),
+        tuple(id(d) for d in defaults),
+        tuple(sorted((k, id(v)) for k, v in kwdefaults.items())),
+    )
+    if key not in cache and len(cache) >= 8:
+        import warnings
+
+        warnings.warn(
+            "compiled 8+ distinct loss functions; pass one stable loss_fn to avoid "
+            "recompilation",
+            stacklevel=3,
+        )
+    return key
+
+
 class DataParallelOptimizer:
     """Wrap an optax optimizer for data-parallel training (reference ``:851``).
 
@@ -107,8 +140,12 @@ class DataParallelOptimizer:
         if loss_fn is None:
             raise TypeError("step() requires loss_fn(params, *batch)")
         values = tuple(_to_value(b) for b in batch)
-        step_fn = self._step_fns.get(loss_fn)
-        if step_fn is None:
+        # see _loss_fn_cache_key: re-created lambdas with the same code/captures
+        # reuse the compiled step; the cached entry keeps a strong reference to its
+        # loss_fn so the captured ids stay live
+        key = _loss_fn_cache_key(loss_fn, self._step_fns)
+        entry = self._step_fns.get(key)
+        if entry is None:
             opt = self.local_optimizer
 
             @jax.jit
@@ -118,7 +155,8 @@ class DataParallelOptimizer:
                 params = optax.apply_updates(params, updates)
                 return params, opt_state, loss
 
-            step_fn = self._step_fns[loss_fn] = _step
+            entry = self._step_fns[key] = (_step, loss_fn)
+        step_fn = entry[0]
         params, self._opt_state, loss = step_fn(self._model.params, self._opt_state, *values)
         self._model.params = params
         # returned as a device scalar: the step stays asynchronously dispatched on TPU —
@@ -370,9 +408,12 @@ class DASO:
         if self._stacked_params is None:
             self._materialize()
         values = tuple(_to_value(b) for b in batch)
-        step_fn = self._step_fns.get(loss_fn)
-        if step_fn is None:
-            step_fn = self._step_fns[loss_fn] = self._build_step(loss_fn)
+        # same keying contract as DataParallelOptimizer.step (see _loss_fn_cache_key)
+        key = _loss_fn_cache_key(loss_fn, self._step_fns)
+        entry = self._step_fns.get(key)
+        if entry is None:
+            entry = self._step_fns[key] = (self._build_step(loss_fn), loss_fn)
+        step_fn = entry[0]
         self._stacked_params, self._stacked_opt_state, loss = step_fn(
             self._stacked_params, self._stacked_opt_state, *values
         )

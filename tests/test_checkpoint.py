@@ -6,9 +6,12 @@ import shutil
 import tempfile
 
 import numpy as np
+import pytest
 
 import heat_tpu as ht
 from heat_tpu.testing import TestCase
+
+pytest.importorskip("orbax.checkpoint")
 
 
 class TestCheckpoint(TestCase):

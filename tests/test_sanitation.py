@@ -1,0 +1,89 @@
+"""Sanitation and stride-tricks tests (reference heat/core/tests/test_sanitation.py,
+test_stride_tricks.py)."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.core import sanitation, stride_tricks
+from heat_tpu.testing import TestCase
+
+
+class TestSanitation(TestCase):
+    def test_sanitize_in(self):
+        sanitation.sanitize_in(ht.ones(3))
+        with self.assertRaises(TypeError):
+            sanitation.sanitize_in(np.ones(3))
+
+    def test_sanitize_infinity(self):
+        self.assertEqual(
+            sanitation.sanitize_infinity(ht.arange(5, dtype=ht.int32)),
+            np.iinfo(np.int32).max,
+        )
+        self.assertEqual(
+            sanitation.sanitize_infinity(ht.ones(3, dtype=ht.float32)),
+            float(np.finfo(np.float32).max),
+        )
+
+    def test_sanitize_out(self):
+        out = ht.zeros((4,), split=0)
+        sanitation.sanitize_out(out, (4,), 0, out.comm)
+        with self.assertRaises(TypeError):
+            sanitation.sanitize_out(np.zeros(4), (4,), 0, out.comm)
+        with self.assertRaises(ValueError):
+            sanitation.sanitize_out(out, (5,), 0, out.comm)
+
+    def test_sanitize_distribution(self):
+        a = ht.arange(8, split=0)
+        b = ht.arange(8, split=None)
+        b2 = sanitation.sanitize_distribution(b, target=a)
+        self.assertEqual(b2.split, 0)
+        np.testing.assert_array_equal(b2.numpy(), b.numpy())
+
+    def test_scalar_to_1d(self):
+        s = ht.array(5.0)
+        v = sanitation.scalar_to_1d(s)
+        self.assertEqual(v.gshape, (1,))
+
+    def test_sanitize_sequence(self):
+        self.assertEqual(sanitation.sanitize_sequence([1, 2]), [1, 2])
+        self.assertEqual(sanitation.sanitize_sequence((1, 2)), [1, 2])
+
+
+class TestStrideTricks(TestCase):
+    def test_broadcast_shape(self):
+        self.assertEqual(stride_tricks.broadcast_shape((5, 4), (4,)), (5, 4))
+        self.assertEqual(stride_tricks.broadcast_shape((1, 3), (2, 1)), (2, 3))
+        self.assertEqual(stride_tricks.broadcast_shapes((2, 1, 4), (3, 1), (1,)), (2, 3, 4))
+        with self.assertRaises(ValueError):
+            stride_tricks.broadcast_shape((3,), (4,))
+
+    def test_sanitize_axis(self):
+        self.assertEqual(stride_tricks.sanitize_axis((4, 5), -1), 1)
+        self.assertEqual(stride_tricks.sanitize_axis((4, 5), None), None)
+        self.assertEqual(stride_tricks.sanitize_axis((4, 5, 6), (0, -1)), (0, 2))
+        with self.assertRaises(ValueError):
+            stride_tricks.sanitize_axis((4, 5), 2)
+        with self.assertRaises(TypeError):
+            stride_tricks.sanitize_axis((4, 5), "x")
+
+    def test_sanitize_shape(self):
+        self.assertEqual(stride_tricks.sanitize_shape(5), (5,))
+        self.assertEqual(stride_tricks.sanitize_shape((3, 4)), (3, 4))
+        with self.assertRaises(ValueError):
+            stride_tricks.sanitize_shape((-2, 3))
+        with self.assertRaises((TypeError, ValueError)):
+            stride_tricks.sanitize_shape("bad")
+
+    def test_sanitize_slice(self):
+        sl = stride_tricks.sanitize_slice(slice(None, None, None), 10)
+        self.assertEqual((sl.start, sl.stop, sl.step), (0, 10, 1))
+        sl = stride_tricks.sanitize_slice(slice(-3, None, None), 10)
+        self.assertEqual(sl.start, 7)
+        with self.assertRaises(TypeError):
+            stride_tricks.sanitize_slice("nope", 10)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
