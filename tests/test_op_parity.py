@@ -442,6 +442,80 @@ class TestStatisticsSweeps(TestCase):
             pass
 
 
+class TestCloseness(TestCase):
+    """allclose/isclose parity incl. equal_nan and mixed splits (reference
+    logical.py:109,229 implements these with an Allreduce)."""
+
+    def test_isclose_sweep(self):
+        a = np.array([1.0, 1.0 + 5e-6, np.nan, np.inf, -np.inf, 0.0], np.float64)
+        b = np.array([1.0, 1.0, np.nan, np.inf, np.inf, 1e-9], np.float64)
+        for equal_nan in (False, True):
+            expected = np.isclose(a, b, equal_nan=equal_nan)
+            for sa in (None, 0):
+                for sb in (None, 0):
+                    got = ht.isclose(
+                        ht.array(a, split=sa), ht.array(b, split=sb), equal_nan=equal_nan
+                    )
+                    np.testing.assert_array_equal(
+                        got.numpy(), expected, err_msg=f"{sa},{sb},equal_nan={equal_nan}"
+                    )
+
+    def test_allclose_tolerances(self):
+        a = np.ones(20, np.float64)
+        b = a + 1e-6
+        for split in (None, 0):
+            ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+            self.assertTrue(ht.allclose(ha, hb, atol=1e-5))
+            self.assertFalse(ht.allclose(ha, hb, rtol=0.0, atol=1e-8))
+            self.assertTrue(ht.allclose(ha, hb * 1.0, rtol=1e-4))
+
+    def test_allclose_nan(self):
+        a = np.array([1.0, np.nan])
+        for split in (None, 0):
+            ha = ht.array(a, split=split)
+            self.assertFalse(ht.allclose(ha, ha))
+            self.assertTrue(ht.allclose(ha, ha, equal_nan=True))
+
+
+class TestRandomMoments(TestCase):
+    """Distribution sanity at scale across dtypes and splits."""
+
+    def test_randn_moments(self):
+        ht.random.seed(11)
+        for split in (None, 0):
+            x = ht.random.randn(40_000, split=split).numpy()
+            self.assertAlmostEqual(float(x.mean()), 0.0, delta=0.02)
+            self.assertAlmostEqual(float(x.std()), 1.0, delta=0.02)
+
+    def test_rand_uniform_moments(self):
+        ht.random.seed(12)
+        x = ht.random.rand(40_000, split=0).numpy()
+        self.assertAlmostEqual(float(x.mean()), 0.5, delta=0.01)
+        self.assertAlmostEqual(float(x.var()), 1.0 / 12.0, delta=0.005)
+        self.assertGreaterEqual(x.min(), 0.0)
+        self.assertLess(x.max(), 1.0)
+
+    def test_randint_uniformity(self):
+        ht.random.seed(13)
+        x = ht.random.randint(0, 10, (50_000,), split=0).numpy()
+        counts = np.bincount(x, minlength=10)
+        # each bucket within 10% of uniform at n=50k
+        np.testing.assert_allclose(counts / len(x), 0.1, atol=0.01)
+
+    def test_normal_params(self):
+        ht.random.seed(14)
+        x = ht.random.normal(3.0, 2.0, (30_000,), split=0).numpy()
+        self.assertAlmostEqual(float(x.mean()), 3.0, delta=0.05)
+        self.assertAlmostEqual(float(x.std()), 2.0, delta=0.05)
+
+    def test_dtype_coverage(self):
+        for dt in (ht.float32, ht.float64):
+            x = ht.random.rand(100, split=0, dtype=dt)
+            self.assertIs(x.dtype, dt)
+        xi = ht.random.randint(0, 5, (100,), split=0)
+        self.assertTrue(ht.issubdtype(xi.dtype, ht.integer))
+
+
 if __name__ == "__main__":
     import unittest
 
