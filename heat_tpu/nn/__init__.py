@@ -5,4 +5,5 @@ native module system in :mod:`.modules` is the fallthrough surface here."""
 from .data_parallel import *
 from .modules import *
 from .attention import *
-from . import attention, data_parallel, functional, modules
+from .recurrent import *
+from . import attention, data_parallel, functional, modules, recurrent

@@ -112,11 +112,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         q = query.larray
         k = key.larray if isinstance(key, DNDarray) else key
         v = value.larray if isinstance(value, DNDarray) else value
-        out = _dense_attention(q, k, v, attn_mask, is_causal, scale)
+        m = attn_mask.larray if isinstance(attn_mask, DNDarray) else attn_mask
+        out = _dense_attention(q, k, v, m, is_causal, scale)
         return wrap_result(out, query, query.split)
     k = key.larray if isinstance(key, DNDarray) else key
     v = value.larray if isinstance(value, DNDarray) else value
-    return _dense_attention(query, k, v, attn_mask, is_causal, scale)
+    m = attn_mask.larray if isinstance(attn_mask, DNDarray) else attn_mask
+    return _dense_attention(query, k, v, m, is_causal, scale)
 
 
 def ring_attention(q, k, v, axis_name: str, is_causal: bool = False,
@@ -255,6 +257,7 @@ class MultiheadAttention(Module):
         else:
             q_in = k_in = v_in = x
         unwrap = lambda t: t.larray if isinstance(t, DNDarray) else t
+        attn_mask = unwrap(attn_mask) if attn_mask is not None else None
         proto = q_in if isinstance(q_in, DNDarray) else None
         seq_axis_in = 1 if self.batch_first else 0
         seq_split = (

@@ -36,12 +36,26 @@ __all__ = [
     "dropout2d",
     "batch_norm",
     "layer_norm",
+    "group_norm",
     "flatten",
     "one_hot",
     "nll_loss",
     "cross_entropy",
     "mse_loss",
     "l1_loss",
+    "silu",
+    "mish",
+    "softplus",
+    "hardtanh",
+    "embedding",
+    "conv_transpose2d",
+    "adaptive_avg_pool2d",
+    "adaptive_max_pool2d",
+    "pad",
+    "binary_cross_entropy",
+    "binary_cross_entropy_with_logits",
+    "smooth_l1_loss",
+    "huber_loss",
 ]
 
 
@@ -288,3 +302,195 @@ def l1_loss(pred, target, reduction: str = "mean"):
     if reduction == "sum":
         return jnp.sum(d)
     return d
+
+
+silu = _elementwise(jax.nn.silu)
+mish = _elementwise(jax.nn.mish)
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0):
+    """torch.nn.functional.softplus: linear above ``threshold`` for stability."""
+    return _elementwise(
+        lambda v: jnp.where(v * beta > threshold, v, jax.nn.softplus(v * beta) / beta)
+    )(x)
+
+
+def hardtanh(x, min_val: float = -1.0, max_val: float = 1.0):
+    return _elementwise(lambda v: jnp.clip(v, min_val, max_val))(x)
+
+
+def embedding(x, weight, padding_idx: Optional[int] = None):
+    """Row lookup (torch.nn.functional.embedding). ``padding_idx`` rows still look
+    up (their gradient-zeroing is a training-time property of the parameter row,
+    which Embedding.init already zeroes)."""
+    v, proto = _unwrap(x)
+    out = jnp.take(weight, v.astype(jnp.int32), axis=0)
+    if proto is not None:
+        from ..core._operations import wrap_result
+
+        return wrap_result(out, proto, proto.split)
+    return out
+
+
+def group_norm(x, num_groups: int, weight=None, bias=None, eps: float = 1e-5):
+    """torch.nn.functional.group_norm over (N, C, *spatial)."""
+    v, proto = _unwrap(x)
+    n, c = v.shape[:2]
+    if c % num_groups:
+        raise ValueError(f"num_channels {c} not divisible by num_groups {num_groups}")
+    grouped = v.reshape(n, num_groups, c // num_groups, *v.shape[2:])
+    axes = tuple(range(2, grouped.ndim))
+    mean = jnp.mean(grouped, axis=axes, keepdims=True)
+    var = jnp.var(grouped, axis=axes, keepdims=True)
+    out = ((grouped - mean) / jnp.sqrt(var + eps)).reshape(v.shape)
+    shape = (1, -1) + (1,) * (v.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def conv_transpose2d(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups: int = 1, dilation=1):
+    """torch.nn.functional.conv_transpose2d: x (N,C,H,W), weight (C, O/groups, kH, kW).
+
+    Implemented as the standard fractionally-strided convolution: dilate the input
+    by ``stride``, convolve with the spatially-flipped, in/out-swapped kernel.
+    """
+    v, proto = _unwrap(x)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    oph, opw = _pair(output_padding)
+    dh, dw = _pair(dilation)
+    cin, og, kh, kw = weight.shape
+    # (in, out/groups, kh, kw) -> (out, in/groups, kh, kw), spatially flipped
+    w = weight.reshape(groups, cin // groups, og, kh, kw)
+    w = jnp.flip(w, axis=(-2, -1)).transpose(0, 2, 1, 3, 4).reshape(groups * og, cin // groups, kh, kw)
+    pad_h = dh * (kh - 1) - ph
+    pad_w = dw * (kw - 1) - pw
+    out = jax.lax.conv_general_dilated(
+        v,
+        w.astype(v.dtype),
+        window_strides=(1, 1),
+        padding=((pad_h, pad_h + oph), (pad_w, pad_w + opw)),
+        lhs_dilation=(sh, sw),
+        rhs_dilation=(dh, dw),
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    if bias is not None:
+        out = out + bias.astype(out.dtype).reshape(1, -1, 1, 1)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def _adaptive_windows(in_size: int, out_size: int):
+    """torch's adaptive pooling windows: start=floor(i*I/O), end=ceil((i+1)*I/O)."""
+    starts = [(i * in_size) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool2d(v, output_size, reduce_fn):
+    oh, ow = _pair(output_size)
+    h, w = v.shape[-2], v.shape[-1]
+    hs, he = _adaptive_windows(h, oh)
+    ws, we = _adaptive_windows(w, ow)
+    rows = []
+    for i in range(oh):
+        cols = []
+        for j in range(ow):
+            cols.append(reduce_fn(v[..., hs[i]:he[i], ws[j]:we[j]], axis=(-2, -1)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+def adaptive_avg_pool2d(x, output_size):
+    """torch.nn.functional.adaptive_avg_pool2d over (..., H, W)."""
+    v, proto = _unwrap(x)
+    out = _adaptive_pool2d(v, output_size, jnp.mean)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def adaptive_max_pool2d(x, output_size):
+    v, proto = _unwrap(x)
+    out = _adaptive_pool2d(v, output_size, jnp.max)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def pad(x, pad_widths, mode: str = "constant", value: float = 0.0):
+    """torch.nn.functional.pad: ``pad_widths`` pairs up from the LAST dim —
+    (left, right[, top, bottom[, ...]])."""
+    v, proto = _unwrap(x)
+    if len(pad_widths) % 2:
+        raise ValueError("pad_widths must hold (before, after) pairs")
+    npairs = len(pad_widths) // 2
+    cfg = [(0, 0)] * (v.ndim - npairs) + [
+        (int(pad_widths[2 * i]), int(pad_widths[2 * i + 1])) for i in range(npairs - 1, -1, -1)
+    ]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    out = (
+        jnp.pad(v, cfg, mode="constant", constant_values=value)
+        if jmode == "constant"
+        else jnp.pad(v, cfg, mode=jmode)
+    )
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def binary_cross_entropy(pred, target, reduction: str = "mean"):
+    """torch semantics: inputs are probabilities; log clamped at -100."""
+    p, _ = _unwrap(pred)
+    t, _ = _unwrap(target)
+    lo = jnp.maximum(jnp.log(p), -100.0)
+    l1 = jnp.maximum(jnp.log1p(-p), -100.0)
+    loss = -(t * lo + (1.0 - t) * l1)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def binary_cross_entropy_with_logits(pred, target, reduction: str = "mean",
+                                     pos_weight=None):
+    """Numerically-stable sigmoid + BCE (torch semantics)."""
+    z, _ = _unwrap(pred)
+    t, _ = _unwrap(target)
+    # log(1+exp(-|z|)) + max(z,0) - z*t   (with optional positive-class weight)
+    log_sig = jax.nn.log_sigmoid(z)
+    log_sig_neg = jax.nn.log_sigmoid(-z)
+    if pos_weight is not None:
+        loss = -(pos_weight * t * log_sig + (1.0 - t) * log_sig_neg)
+    else:
+        loss = -(t * log_sig + (1.0 - t) * log_sig_neg)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def smooth_l1_loss(pred, target, reduction: str = "mean", beta: float = 1.0):
+    """torch semantics: quadratic below ``beta``, linear above."""
+    p, _ = _unwrap(pred)
+    t, _ = _unwrap(target)
+    d = jnp.abs(p - t)
+    loss = jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def huber_loss(pred, target, reduction: str = "mean", delta: float = 1.0):
+    """torch semantics: smooth_l1 scaled by delta (quadratic below ``delta``)."""
+    p, _ = _unwrap(pred)
+    t, _ = _unwrap(target)
+    d = jnp.abs(p - t)
+    loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss

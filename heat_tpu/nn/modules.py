@@ -23,31 +23,49 @@ from ..core.dndarray import DNDarray
 __all__ = [
     "Module",
     "Linear",
+    "Embedding",
     "Conv2d",
+    "ConvTranspose2d",
     "MaxPool2d",
     "AvgPool2d",
+    "AdaptiveAvgPool2d",
+    "AdaptiveMaxPool2d",
     "BatchNorm1d",
     "BatchNorm2d",
+    "GroupNorm",
+    "InstanceNorm2d",
     "LayerNorm",
     "ReLU",
+    "ReLU6",
     "LeakyReLU",
+    "PReLU",
     "GELU",
     "ELU",
+    "SiLU",
+    "Mish",
+    "Softplus",
+    "Hardtanh",
     "Tanh",
     "Sigmoid",
     "Softmax",
     "LogSoftmax",
     "Identity",
     "Flatten",
+    "Unflatten",
     "Dropout",
     "Dropout2d",
     "Remat",
     "remat",
     "Sequential",
+    "ModuleList",
     "MSELoss",
     "L1Loss",
     "NLLLoss",
     "CrossEntropyLoss",
+    "BCELoss",
+    "BCEWithLogitsLoss",
+    "SmoothL1Loss",
+    "HuberLoss",
 ]
 
 
@@ -104,6 +122,10 @@ class Module:
         for (name, m), k in zip(subs, keys):
             m._params = params[name]
             m._ctx = (k, train)
+            if isinstance(m, ModuleList):
+                # list containers are never .apply()'d themselves — forward code
+                # indexes into them — so their children must be bound here
+                m._bind(params[name], k, train)
 
     # ------------------------------------------------------------- stateful veneer
     @property
@@ -519,3 +541,293 @@ class CrossEntropyLoss:
         lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
         picked = jnp.take_along_axis(lp, t[:, None].astype(jnp.int64), axis=1)
         return -jnp.mean(picked)
+
+
+class Embedding(Module):
+    """Lookup table (torch.nn.Embedding semantics: N(0,1) init; the ``padding_idx``
+    row is zeroed at init)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None):
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+
+    def init(self, key):
+        w = jax.random.normal(key, (self.num_embeddings, self.embedding_dim), jnp.float32)
+        if self.padding_idx is not None:
+            w = w.at[self.padding_idx].set(0.0)
+        return {"weight": w}
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.embedding(x, params["weight"], self.padding_idx)
+
+
+class ConvTranspose2d(Module):
+    """torch.nn.ConvTranspose2d semantics: weight (in, out/groups, kH, kW),
+    LeCun-style uniform init with bound 1/sqrt(out/groups * kH * kW) — torch uses
+    the same fan computation for the transposed conv."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups: int = 1, bias: bool = True,
+                 dilation=1):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.groups = groups
+        self.bias = bias
+        self.dilation = dilation
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        kh, kw = self.kernel_size
+        fan_in = self.out_channels // self.groups * kh * kw
+        bound = 1.0 / np.sqrt(fan_in)
+        w = jax.random.uniform(
+            k1,
+            (self.in_channels, self.out_channels // self.groups, kh, kw),
+            jnp.float32,
+            -bound,
+            bound,
+        )
+        if not self.bias:
+            return {"weight": w}
+        b = jax.random.uniform(k2, (self.out_channels,), jnp.float32, -bound, bound)
+        return {"weight": w, "bias": b}
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.conv_transpose2d(
+            x,
+            params["weight"],
+            params.get("bias"),
+            stride=self.stride,
+            padding=self.padding,
+            output_padding=self.output_padding,
+            groups=self.groups,
+            dilation=self.dilation,
+        )
+
+
+class AdaptiveAvgPool2d(Module):
+    def __init__(self, output_size):
+        self.output_size = output_size
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool2d(Module):
+    def __init__(self, output_size):
+        self.output_size = output_size
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class GroupNorm(Module):
+    """torch.nn.GroupNorm: per-group normalization over (N, C, *)."""
+
+    def __init__(self, num_groups: int, num_channels: int, eps: float = 1e-5,
+                 affine: bool = True):
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.eps = eps
+        self.affine = affine
+
+    def init(self, key):
+        if not self.affine:
+            return ()
+        return {
+            "weight": jnp.ones((self.num_channels,), jnp.float32),
+            "bias": jnp.zeros((self.num_channels,), jnp.float32),
+        }
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        weight = params.get("weight") if self.affine else None
+        bias = params.get("bias") if self.affine else None
+        return F.group_norm(x, self.num_groups, weight, bias, self.eps)
+
+
+class InstanceNorm2d(Module):
+    """torch.nn.InstanceNorm2d (default config: no affine, no running stats) —
+    per-sample, per-channel normalization = GroupNorm with one group per channel."""
+
+    def __init__(self, num_features: int, eps: float = 1e-5, affine: bool = False):
+        self.num_features = num_features
+        self.eps = eps
+        self.affine = affine
+
+    def init(self, key):
+        if not self.affine:
+            return ()
+        return {
+            "weight": jnp.ones((self.num_features,), jnp.float32),
+            "bias": jnp.zeros((self.num_features,), jnp.float32),
+        }
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        weight = params.get("weight") if self.affine else None
+        bias = params.get("bias") if self.affine else None
+        return F.group_norm(x, self.num_features, weight, bias, self.eps)
+
+
+class ReLU6(Module):
+    def apply(self, params, x, *, key=None, train=False):
+        return jnp.clip(x, 0.0, 6.0)
+
+
+class PReLU(Module):
+    """torch.nn.PReLU: leaky-relu with a learnable per-channel (or scalar) slope."""
+
+    def __init__(self, num_parameters: int = 1, init: float = 0.25):
+        self.num_parameters = num_parameters
+        self.init_value = init
+
+    def init(self, key):
+        return {"weight": jnp.full((self.num_parameters,), self.init_value, jnp.float32)}
+
+    def apply(self, params, x, *, key=None, train=False):
+        a = params["weight"]
+        if self.num_parameters > 1 and x.ndim > 1:
+            a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x >= 0, x, a * x)
+
+
+class SiLU(Module):
+    def apply(self, params, x, *, key=None, train=False):
+        return jax.nn.silu(x)
+
+
+class Mish(Module):
+    def apply(self, params, x, *, key=None, train=False):
+        return jax.nn.mish(x)
+
+
+class Softplus(Module):
+    def __init__(self, beta: float = 1.0, threshold: float = 20.0):
+        self.beta = beta
+        self.threshold = threshold
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.softplus(x, self.beta, self.threshold)
+
+
+class Hardtanh(Module):
+    def __init__(self, min_val: float = -1.0, max_val: float = 1.0):
+        self.min_val = min_val
+        self.max_val = max_val
+
+    def apply(self, params, x, *, key=None, train=False):
+        return jnp.clip(x, self.min_val, self.max_val)
+
+
+class Unflatten(Module):
+    """torch.nn.Unflatten: expand one dim into a shape."""
+
+    def __init__(self, dim: int, unflattened_size):
+        self.dim = dim
+        self.unflattened_size = tuple(unflattened_size)
+
+    def apply(self, params, x, *, key=None, train=False):
+        d = self.dim if self.dim >= 0 else x.ndim + self.dim
+        shape = x.shape[:d] + self.unflattened_size + x.shape[d + 1 :]
+        return x.reshape(shape)
+
+
+class ModuleList(Module):
+    """torch.nn.ModuleList: an indexable container registered like a submodule.
+    Holds no forward logic of its own — subclass forward code indexes into it."""
+
+    def __init__(self, modules: Optional[Sequence[Module]] = None):
+        self.layers = list(modules or [])
+
+    def named_submodules(self):
+        return [(str(i), m) for i, m in enumerate(self.layers)]
+
+    def append(self, module: Module) -> "ModuleList":
+        self.layers.append(module)
+        return self
+
+    def __iter__(self):
+        return iter(self.layers)
+
+    def __len__(self):
+        return len(self.layers)
+
+    def __getitem__(self, idx):
+        return self.layers[idx]
+
+    def init(self, key):
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        return [m.init(k) for m, k in zip(self.layers, keys)]
+
+    def _bind(self, params, key, train):
+        keys = (
+            jax.random.split(key, max(len(self.layers), 1))
+            if key is not None
+            else [None] * len(self.layers)
+        )
+        for m, p, k in zip(self.layers, params, keys):
+            m._params = p
+            m._ctx = (k, train)
+
+    def apply(self, params, x, *, key=None, train=False):
+        raise NotImplementedError("ModuleList is a container; index into it in forward()")
+
+
+class BCELoss:
+    """Binary cross-entropy on probabilities (torch.nn.BCELoss semantics)."""
+
+    def __call__(self, pred, target):
+        from . import functional as F
+
+        return F.binary_cross_entropy(pred, target)
+
+
+class BCEWithLogitsLoss:
+    """Sigmoid + BCE in one numerically-stable op (torch semantics)."""
+
+    def __init__(self, pos_weight=None):
+        self.pos_weight = pos_weight
+
+    def __call__(self, pred, target):
+        from . import functional as F
+
+        return F.binary_cross_entropy_with_logits(pred, target, pos_weight=self.pos_weight)
+
+
+class SmoothL1Loss:
+    def __init__(self, beta: float = 1.0):
+        self.beta = beta
+
+    def __call__(self, pred, target):
+        from . import functional as F
+
+        return F.smooth_l1_loss(pred, target, beta=self.beta)
+
+
+class HuberLoss:
+    def __init__(self, delta: float = 1.0):
+        self.delta = delta
+
+    def __call__(self, pred, target):
+        from . import functional as F
+
+        return F.huber_loss(pred, target, delta=self.delta)

@@ -1,0 +1,195 @@
+"""Recurrent layers (torch.nn.RNN/LSTM/GRU semantics, reached in the reference via
+the torch.nn fall-through, ``heat/nn/__init__.py:18-31``).
+
+The time loop is a ``lax.scan`` — one compiled program regardless of sequence
+length, with the per-step matmuls batched onto the MXU. Parameter names and gate
+orderings match torch exactly (``weight_ih_l{k}``, gates i,f,g,o for LSTM and
+r,z,n for GRU), so state_dicts transfer 1:1.
+
+Unsupported torch options raise at construction: ``bidirectional`` and inter-layer
+``dropout`` (neither is needed by any reference workload).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .modules import Module
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNBase(Module):
+    """Shared machinery: torch param layout, multi-layer scan driver."""
+
+    GATES = 1  # gate multiplier: 1 (RNN), 4 (LSTM), 3 (GRU)
+
+    def __init__(self, input_size: int, hidden_size: int, num_layers: int = 1,
+                 bias: bool = True, batch_first: bool = False,
+                 dropout: float = 0.0, bidirectional: bool = False):
+        if bidirectional:
+            raise NotImplementedError("bidirectional recurrent layers are not supported")
+        if dropout != 0.0:
+            raise NotImplementedError("inter-layer dropout is not supported")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bias = bias
+        self.batch_first = batch_first
+
+    def init(self, key):
+        params = {}
+        g, h = self.GATES, self.hidden_size
+        bound = 1.0 / np.sqrt(h)  # torch: uniform(-1/sqrt(H), 1/sqrt(H)) everywhere
+        keys = jax.random.split(key, self.num_layers * 4)
+        for layer in range(self.num_layers):
+            in_dim = self.input_size if layer == 0 else h
+            k_ih, k_hh, k_bih, k_bhh = keys[layer * 4 : layer * 4 + 4]
+            params[f"weight_ih_l{layer}"] = jax.random.uniform(
+                k_ih, (g * h, in_dim), jnp.float32, -bound, bound
+            )
+            params[f"weight_hh_l{layer}"] = jax.random.uniform(
+                k_hh, (g * h, h), jnp.float32, -bound, bound
+            )
+            if self.bias:
+                params[f"bias_ih_l{layer}"] = jax.random.uniform(
+                    k_bih, (g * h,), jnp.float32, -bound, bound
+                )
+                params[f"bias_hh_l{layer}"] = jax.random.uniform(
+                    k_bhh, (g * h,), jnp.float32, -bound, bound
+                )
+        return params
+
+    # subclasses define: initial state for one layer, and the cell step
+    def _zero_state(self, batch: int, dtype):
+        raise NotImplementedError
+
+    def _cell(self, params, layer, x_t, state):
+        raise NotImplementedError
+
+    def apply(self, params, x, *, key=None, train=False, initial_state=None):
+        squeeze_batch = x.ndim == 2  # torch accepts unbatched (T, I)
+        if squeeze_batch:
+            x = x[:, None, :] if not self.batch_first else x[None]
+            if initial_state is not None:
+                # torch's unbatched h_0/c_0 is (num_layers, H); add the batch dim
+                initial_state = jax.tree.map(lambda s: s[:, None], initial_state)
+        if self.batch_first:
+            x = jnp.swapaxes(x, 0, 1)  # scan over leading time axis
+        batch = x.shape[1]
+        # the cell computes x @ W(f32); the carry must match that promoted dtype
+        # (under the global x64 flag a float64 input promotes the whole recurrence)
+        dtype = jnp.result_type(x.dtype, jnp.float32)
+
+        states = []
+        for layer in range(self.num_layers):
+            if initial_state is None:
+                state0 = self._zero_state(batch, dtype)
+            else:
+                state0 = jax.tree.map(lambda s: s[layer], initial_state)
+
+            def step(state, x_t, layer=layer):
+                new_state, out = self._cell(params, layer, x_t, state)
+                return new_state, out
+
+            final, x = lax.scan(step, state0, x)
+            states.append(final)
+
+        h_n = jax.tree.map(lambda *s: jnp.stack(s), *states)
+        if self.batch_first:
+            x = jnp.swapaxes(x, 0, 1)
+        if squeeze_batch:
+            x = x[:, 0] if not self.batch_first else x[0]
+            h_n = jax.tree.map(lambda s: s[:, 0], h_n)
+        return x, h_n
+
+    def __call__(self, x, initial_state=None):
+        from .modules import _to_value
+        from ..core.dndarray import DNDarray
+
+        value = _to_value(x)
+        out, h_n = self.apply(self.params, value, initial_state=initial_state)
+        if isinstance(x, DNDarray):
+            from ..core._operations import wrap_result
+
+            # output keeps the input's (T, B) / (B, T) layout; only the trailing
+            # feature dim changes, so a time- or batch-axis split survives
+            keep = x.split if (x.split is not None and x.split < x.ndim - 1) else None
+            out = wrap_result(out, x, keep)
+        return out, h_n
+
+
+class RNN(_RNNBase):
+    """torch.nn.RNN with tanh or relu nonlinearity."""
+
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1, nonlinearity="tanh",
+                 bias=True, batch_first=False, dropout=0.0, bidirectional=False):
+        super().__init__(input_size, hidden_size, num_layers, bias, batch_first,
+                         dropout, bidirectional)
+        if nonlinearity not in ("tanh", "relu"):
+            raise ValueError(f"unknown nonlinearity {nonlinearity!r}")
+        self.nonlinearity = nonlinearity
+
+    def _zero_state(self, batch, dtype):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def _cell(self, params, layer, x_t, h):
+        z = x_t @ params[f"weight_ih_l{layer}"].T + h @ params[f"weight_hh_l{layer}"].T
+        if self.bias:
+            z = z + params[f"bias_ih_l{layer}"] + params[f"bias_hh_l{layer}"]
+        h_new = jnp.tanh(z) if self.nonlinearity == "tanh" else jax.nn.relu(z)
+        return h_new, h_new
+
+
+class LSTM(_RNNBase):
+    """torch.nn.LSTM — gate order i, f, g, o; returns (output, (h_n, c_n))."""
+
+    GATES = 4
+
+    def _zero_state(self, batch, dtype):
+        z = jnp.zeros((batch, self.hidden_size), dtype)
+        return (z, z)
+
+    def _cell(self, params, layer, x_t, state):
+        h, c = state
+        z = x_t @ params[f"weight_ih_l{layer}"].T + h @ params[f"weight_hh_l{layer}"].T
+        if self.bias:
+            z = z + params[f"bias_ih_l{layer}"] + params[f"bias_hh_l{layer}"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+
+class GRU(_RNNBase):
+    """torch.nn.GRU — gate order r, z, n with torch's n = tanh(W_in x + b_in +
+    r * (W_hn h + b_hn)) formulation."""
+
+    GATES = 3
+
+    def _zero_state(self, batch, dtype):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def _cell(self, params, layer, x_t, h):
+        gi = x_t @ params[f"weight_ih_l{layer}"].T
+        gh = h @ params[f"weight_hh_l{layer}"].T
+        if self.bias:
+            gi = gi + params[f"bias_ih_l{layer}"]
+            gh = gh + params[f"bias_hh_l{layer}"]
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        h_new = (1.0 - z) * n + z * h
+        return h_new, h_new

@@ -76,6 +76,19 @@ class TestDenseSDPA:
         )
         np.testing.assert_allclose(np.asarray(out_bool), np.asarray(out_add), rtol=1e-5, atol=1e-5)
 
+    def test_dndarray_mask(self):
+        """attn_mask given as a DNDarray is unwrapped like the other operands."""
+        rng = np.random.default_rng(12)
+        q = rng.standard_normal((1, 1, 6, 4), np.float32)
+        keep = np.triu(np.ones((6, 6), bool))
+        want = scaled_dot_product_attention(
+            jnp.array(q), jnp.array(q), jnp.array(q), attn_mask=jnp.array(keep)
+        )
+        got = scaled_dot_product_attention(
+            ht.array(q), ht.array(q), ht.array(q), attn_mask=ht.array(keep)
+        )
+        np.testing.assert_allclose(got.numpy(), np.asarray(want), rtol=1e-5, atol=1e-6)
+
     def test_torch_sdpa_parity(self):
         torch = pytest.importorskip("torch")
         rng = np.random.default_rng(3)
