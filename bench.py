@@ -129,6 +129,34 @@ def _bench_dp_step(ht, jax, jnp, on_tpu):
     return n, d, h, best
 
 
+def _bench_attention(ht, jax, jnp, on_tpu):
+    """Long-context causal self-attention throughput (blockwise sdpa, bf16 on MXU).
+
+    Single-chip this is the dense online-softmax path; on a mesh the identical math
+    runs as ring attention (``heat_tpu/nn/attention.py``). FLOP count: 2 matmuls of
+    2*B*H*T^2*D each, halved by causality."""
+    b, h, t, d = (8, 16, 4096, 64) if on_tpu else (2, 2, 256, 32)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    from heat_tpu.nn.attention import scaled_dot_product_attention as sdpa
+
+    q = jax.random.normal(jax.random.key(7), (b, h, t, d), dt)
+    k = jax.random.normal(jax.random.key(8), (b, h, t, d), dt)
+    v = jax.random.normal(jax.random.key(9), (b, h, t, d), dt)
+    fn = jax.jit(lambda q, k, v: sdpa(q, k, v, is_causal=True))
+    float(jnp.sum(fn(q, k, v).astype(jnp.float32)))  # compile + warmup
+    iters = 10
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(q, k, v)
+        float(jnp.sum(out.astype(jnp.float32)))  # sync
+        best = min(best, (time.perf_counter() - t0) / iters)
+    flops = 2 * 2 * b * h * t * t * d / 2  # two matmuls, causal halves the work
+    return b, h, t, d, flops / best / 1e12
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -141,6 +169,7 @@ def main():
     kn, kd, kk, kmeans_s = _bench_kmeans(ht, jax, jnp, on_tpu)
     hm, hn, hrank, hsvd_s = _bench_hsvd(ht, jax, jnp, on_tpu)
     dn, dd, dh, dp_s = _bench_dp_step(ht, jax, jnp, on_tpu)
+    ab, ah, at, ad, attn_tflops = _bench_attention(ht, jax, jnp, on_tpu)
 
     # vs_baseline = fraction of the chip's bf16 matmul peak; CPU: no target
     peak = _peak_tflops(jax) if on_tpu else max(tflops, 1e-9)
@@ -166,6 +195,11 @@ def main():
                         "metric": f"dp_mlp_step_{dn}x{dd}_h{dh}_split0",
                         "value": round(dp_s * 1e3, 3),
                         "unit": "ms",
+                    },
+                    {
+                        "metric": f"attention_causal_b{ab}h{ah}t{at}d{ad}_tflops",
+                        "value": round(attn_tflops, 3),
+                        "unit": "TFLOP/s",
                     },
                 ],
             }

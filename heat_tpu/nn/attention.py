@@ -52,11 +52,17 @@ _NEG_INF = float(np.finfo(np.float32).min)
 
 
 def _dense_attention(q, k, v, mask=None, is_causal=False, scale=None):
-    """Blockwise dense attention on local arrays, f32 accumulation.
+    """Single-device exact attention on local arrays, f32 accumulation.
 
     q: (..., Tq, D), k/v: (..., Tk, D). Causal masking is top-left aligned
-    (position i attends keys ≤ i), matching torch sdpa.
+    (position i attends keys ≤ i), matching torch sdpa. On TPU, unmasked
+    block-even shapes run the flash Pallas kernel (streaming VMEM, no (T,T)
+    score matrix in HBM); everything else takes the XLA path below.
     """
+    from ..core.kernels.flash_attention import flash_attention, use_flash
+
+    if use_flash(q, k, v, mask):
+        return flash_attention(q, k, v, is_causal, scale)
     d = q.shape[-1]
     s = (1.0 / math.sqrt(d)) if scale is None else scale
     scores = jnp.einsum(

@@ -320,10 +320,12 @@ def hardtanh(x, min_val: float = -1.0, max_val: float = 1.0):
 
 
 def embedding(x, weight, padding_idx: Optional[int] = None):
-    """Row lookup (torch.nn.functional.embedding). ``padding_idx`` rows still look
-    up (their gradient-zeroing is a training-time property of the parameter row,
-    which Embedding.init already zeroes)."""
+    """Row lookup (torch.nn.functional.embedding). The ``padding_idx`` row takes no
+    gradient (torch zeroes its grad every backward), so a zero-initialized padding
+    row stays exactly zero for the whole training run."""
     v, proto = _unwrap(x)
+    if padding_idx is not None:
+        weight = weight.at[padding_idx].set(jax.lax.stop_gradient(weight[padding_idx]))
     out = jnp.take(weight, v.astype(jnp.int32), axis=0)
     if proto is not None:
         from ..core._operations import wrap_result
@@ -471,11 +473,15 @@ def binary_cross_entropy_with_logits(pred, target, reduction: str = "mean",
 
 
 def smooth_l1_loss(pred, target, reduction: str = "mean", beta: float = 1.0):
-    """torch semantics: quadratic below ``beta``, linear above."""
+    """torch semantics: quadratic below ``beta``, linear above; ``beta=0`` is pure
+    L1 (guarded separately — a 0/0 in the untaken where-branch would NaN the grad)."""
     p, _ = _unwrap(pred)
     t, _ = _unwrap(target)
     d = jnp.abs(p - t)
-    loss = jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta)
+    if beta == 0.0:
+        loss = d
+    else:
+        loss = jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta)
     if reduction == "mean":
         return jnp.mean(loss)
     if reduction == "sum":

@@ -787,6 +787,8 @@ class ModuleList(Module):
         for m, p, k in zip(self.layers, params, keys):
             m._params = p
             m._ctx = (k, train)
+            if isinstance(m, ModuleList):  # nested lists bind their children too
+                m._bind(p, k, train)
 
     def apply(self, params, x, *, key=None, train=False):
         raise NotImplementedError("ModuleList is a container; index into it in forward()")

@@ -1,0 +1,68 @@
+"""Flash-attention Pallas kernel: interpret-mode parity on the CPU mesh (the real
+compile path is exercised on TPU by bench.py and the verify drive)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from heat_tpu.core.kernels.flash_attention import (
+    _flash_pallas,
+    flash_attention_reference,
+    use_flash,
+)
+
+
+class TestFlashKernel:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("shape", [(1, 2, 1024, 64), (2, 1, 512, 128)])
+    def test_interpret_parity(self, causal, shape):
+        rng = np.random.default_rng(0)
+        q, k, v = (jnp.array(rng.standard_normal(shape), jnp.float32) for _ in range(3))
+        scale = 1.0 / np.sqrt(shape[-1])
+        got = _flash_pallas(q, k, v, causal, float(scale), 512, 512, interpret=True)
+        want = flash_attention_reference(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+    def test_interpret_parity_cross_lengths(self):
+        """Tq != Tk (cross-attention shapes)."""
+        rng = np.random.default_rng(1)
+        q = jnp.array(rng.standard_normal((1, 1, 512, 64)), jnp.float32)
+        k = jnp.array(rng.standard_normal((1, 1, 1536, 64)), jnp.float32)
+        v = jnp.array(rng.standard_normal((1, 1, 1536, 64)), jnp.float32)
+        got = _flash_pallas(q, k, v, False, float(1 / np.sqrt(64)), 512, 512, interpret=True)
+        want = flash_attention_reference(q, k, v, False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+    def test_causal_skips_above_diagonal(self):
+        """Causal output is independent of keys strictly above the diagonal —
+        poisoning the future keys with huge values must not change the result."""
+        rng = np.random.default_rng(2)
+        q = jnp.array(rng.standard_normal((1, 1, 1024, 64)), jnp.float32)
+        k = jnp.array(rng.standard_normal((1, 1, 1024, 64)), jnp.float32)
+        v = jnp.array(rng.standard_normal((1, 1, 1024, 64)), jnp.float32)
+        # queries in the first block attend only the first block of keys
+        k_poison = k.at[:, :, 512:, :].set(1e4)
+        a = _flash_pallas(q, k, v, True, 0.125, 512, 512, interpret=True)
+        b = _flash_pallas(q, k_poison, v, True, 0.125, 512, 512, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(a[:, :, :512]), np.asarray(b[:, :, :512]), rtol=1e-5, atol=1e-5
+        )
+
+    def test_use_flash_gating(self):
+        q = jnp.zeros((1, 2, 1024, 64), jnp.float32)
+        # mask present -> no flash
+        assert not use_flash(q, q, q, jnp.zeros((1024, 1024)))
+        # non-block-multiple sequence -> no flash
+        q_ragged = jnp.zeros((1, 2, 1000, 64), jnp.float32)
+        assert not use_flash(q_ragged, q_ragged, q_ragged, None)
+        # CPU backend -> no flash (suite runs on the CPU mesh)
+        assert not use_flash(q, q, q, None)
+        # interpret mode ignores the backend
+        assert use_flash(q, q, q, None, interpret=True)
+
+    def test_vmem_gate_rejects_huge_kv(self):
+        q = jnp.zeros((1, 1, 512, 64), jnp.bfloat16)
+        k = jnp.zeros((1, 1, 1 << 20, 64), jnp.bfloat16)  # 128 MB of k+v
+        assert not use_flash(q, k, k, None, interpret=True)

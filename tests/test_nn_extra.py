@@ -371,3 +371,49 @@ class TestContainers:
         g = jax.grad(lambda p: jnp.sum(net.apply(p, jnp.ones((2, 4))) ** 2))(params)
         assert len(g["blocks"]) == 3
         assert any(bool(jnp.any(layer["weight"] != 0)) for layer in g["blocks"])
+
+
+class TestReviewRegressions:
+    def test_embedding_padding_row_takes_no_grad(self):
+        """torch zeroes the padding row's gradient every backward; ours must too."""
+        emb = ht.nn.Embedding(6, 3, padding_idx=0)
+        params = emb.init(jax.random.key(0))
+        idx = jnp.array([0, 1, 0, 2])
+        g = jax.grad(lambda p: jnp.sum(emb.apply(p, idx) ** 2))(params)
+        assert np.allclose(_np(g["weight"][0]), 0.0)
+        assert bool(jnp.any(g["weight"][1] != 0))
+
+    def test_smooth_l1_beta_zero_is_l1_with_finite_grad(self):
+        p = jnp.array([1.0, -2.0, 0.0])
+        t = jnp.array([0.5, -2.0, 1.0])
+        got = F.smooth_l1_loss(p, t, beta=0.0)
+        want = torch.nn.functional.smooth_l1_loss(
+            torch.tensor(_np(p)), torch.tensor(_np(t)), beta=0.0
+        ).item()
+        assert abs(float(got) - want) < 1e-6
+        g = jax.grad(lambda p_: F.smooth_l1_loss(p_, t, beta=0.0))(p)
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+    def test_nested_module_list_binds_children(self):
+        class Net(ht.nn.Module):
+            def __init__(self):
+                self.blocks = ht.nn.ModuleList(
+                    [ht.nn.ModuleList([ht.nn.Linear(4, 4)])]
+                )
+
+            def forward(self, x):
+                return self.blocks[0][0](x)
+
+        net = Net()
+        params = net.init(jax.random.key(1))
+        zeroed = jax.tree.map(jnp.zeros_like, params)
+        out_zero = net.apply(zeroed, jnp.ones((2, 4)))
+        assert np.allclose(_np(out_zero), 0.0)
+        g = jax.grad(lambda p: jnp.sum(net.apply(p, jnp.ones((2, 4))) ** 2))(params)
+        assert bool(jnp.any(g["blocks"][0][0]["weight"] != 0))
+
+    def test_flash_gate_rejects_f64(self):
+        from heat_tpu.core.kernels.flash_attention import use_flash
+
+        q = jnp.zeros((1, 1, 1024, 64), jnp.float64)
+        assert not use_flash(q, q, q, None, interpret=True)
