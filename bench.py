@@ -130,9 +130,10 @@ def _bench_dp_step(ht, jax, jnp, on_tpu):
 
 
 def _bench_attention(ht, jax, jnp, on_tpu):
-    """Long-context causal self-attention throughput (blockwise sdpa, bf16 on MXU).
+    """Long-context causal self-attention throughput (bf16 on MXU).
 
-    Single-chip this is the dense online-softmax path; on a mesh the identical math
+    On TPU this unmasked block-even shape routes through the flash Pallas kernel
+    (``heat_tpu/core/kernels/flash_attention.py``); on a mesh the identical math
     runs as ring attention (``heat_tpu/nn/attention.py``). FLOP count: 2 matmuls of
     2*B*H*T^2*D each, halved by causality."""
     b, h, t, d = (8, 16, 4096, 64) if on_tpu else (2, 2, 256, 32)

@@ -9,11 +9,11 @@ accumulator is written to HBM once. Causal masking skips whole k-blocks above th
 diagonal (the loop's trip count is data-independent per q-block, so the causal
 kernel does ~half the work instead of masking all of it).
 
-Backward: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward pass
-recomputes attention with the XLA dense path and differentiates that — numerically
-identical gradients (both are exact softmax attention), with the forward getting
-the flash memory profile. (A fused Pallas backward is a further optimisation, not
-a semantics change.)
+Backward: the ``jax.custom_vjp`` backward is also Pallas — the forward saves the
+(O, LSE) residuals, ``_dq_kernel`` streams k/v per query block and ``_dkv_kernel``
+streams q/dO per key block, each recomputing its probability tile from the LSE
+(the standard flash backward). Neither direction materialises the (T, T) matrix
+in HBM.
 
 No reference counterpart: the reference has no attention at all (SURVEY §2.4);
 this is TPU-first machinery for the long-context story.
@@ -34,6 +34,10 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 _BQ = 512
 _BK = 512
+# the backward keeps full q/dO plus three (bq,bk) f32 tiles resident; 256-blocks
+# keep the dk/dv kernel under the 16 MB VMEM ceiling at t=4096
+_BWD_BQ = 256
+_BWD_BK = 256
 
 
 def flash_attention_reference(q, k, v, causal: bool = False, scale=None):
@@ -53,8 +57,8 @@ def flash_attention_reference(q, k, v, causal: bool = False, scale=None):
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool, bk: int,
-            compute_dtype=None):
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float, causal: bool,
+            bk: int, compute_dtype=None):
     import jax.experimental.pallas as pl
 
     iq = pl.program_id(1)
@@ -101,6 +105,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool, bk: int,
     upper = jnp.minimum((q_row0 + bq + bk - 1) // bk, nkb) if causal else nkb
     acc, m, l = lax.fori_loop(0, upper, body, (acc0, m0, l0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # log-sum-exp residual for the backward pass: L = m + log(l)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 @functools.partial(
@@ -119,7 +125,7 @@ def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
         kr = k.reshape(bh, tk, d)
         vr = v.reshape(bh, tk, d)
 
-        out = pl.pallas_call(
+        out, lse = pl.pallas_call(
             functools.partial(_kernel, scale=scale, causal=causal, bk=bk,
                               compute_dtype=compute_dtype),
             grid=(bh, tq // bq),
@@ -128,25 +134,190 @@ def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
                 pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
             ],
-            out_specs=pl.BlockSpec(
-                (1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM
-            ),
-            out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            out_specs=[
+                pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+            ],
             interpret=interpret,
         )(qr, kr, vr)
-        return out.reshape(*batch, tq, d)
+        return out.reshape(*batch, tq, d), lse.reshape(*batch, tq)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
+               scale: float, causal: bool, bk: int):
+    """dq_i = Σ_j dS_ij · k_j · scale with dS = P ∘ (dO·Vᵀ − D)."""
+    import jax.experimental.pallas as pl
+
+    iq = pl.program_id(1)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    tk = k_ref.shape[1]
+    nkb = tk // bk
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]  # (bq, 1)
+    dd = dd_ref[0]
+    q_row0 = iq * bq
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = (
+            lax.dot_general(q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            * scale
+        )
+        if causal:
+            rows = q_row0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # (bq, bk), exact probabilities via the saved LSE
+        dp = lax.dot_general(do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)
+        return dq + lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    upper = jnp.minimum((q_row0 + bq + bk - 1) // bk, nkb) if causal else nkb
+    dq = lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dk_ref, dv_ref, *,
+                scale: float, causal: bool, bq: int):
+    """dk_j = Σ_i dSᵀ_ij · q_i · scale,  dv_j = Σ_i Pᵀ_ij · dO_i."""
+    import jax.experimental.pallas as pl
+
+    jk = pl.program_id(1)
+    bk, d = k_ref.shape[1], k_ref.shape[2]
+    tq = q_ref.shape[1]
+    nqb = tq // bq
+
+    kb = k_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+    k_row0 = jk * bk
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * bq, bq), :]  # (bq, 1)
+        dd = dd_ref[0, pl.ds(i * bq, bq), :]
+        s = (
+            lax.dot_general(qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+            * scale
+        )
+        if causal:
+            rows = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = k_row0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(dob, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)
+        dk_new = dk + lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dv_new = dv + lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk_new, dv_new
+
+    # causal: only q-blocks at or below this k-block's first row contribute
+    lower = (k_row0 // bq) if causal else 0
+    dk, dv = lax.fori_loop(
+        lower, nqb, body, (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
+    )
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "bq", "bk", "interpret")
+)
+def _flash_bwd_pallas(q, k, v, o, do, lse, causal: bool, scale: float, bq: int,
+                      bk: int, interpret: bool = False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    with jax.enable_x64(False):
+        *batch, tq, d = q.shape
+        tk = k.shape[-2]
+        bh = math.prod(batch) if batch else 1
+        qr = q.reshape(bh, tq, d)
+        kr = k.reshape(bh, tk, d)
+        vr = v.reshape(bh, tk, d)
+        dor = do.reshape(bh, tq, d)
+        lser = lse.reshape(bh, tq, 1).astype(jnp.float32)
+        # D_i = rowsum(dO ∘ O), one fused elementwise pass over the saved output
+        dd = jnp.sum(
+            dor.astype(jnp.float32) * o.reshape(bh, tq, d).astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
+
+        common = dict(interpret=interpret)
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, scale=scale, causal=causal, bk=bk),
+            grid=(bh, tq // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            **common,
+        )(qr, kr, vr, dor, lser, dd)
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq),
+            grid=(bh, tk // bk),
+            in_specs=[
+                pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, tq, 1), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, tq, 1), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+            ],
+            **common,
+        )(qr, kr, vr, dor, lser, dd)
+        return (
+            dq.reshape(*batch, tq, d),
+            dk.reshape(*batch, tk, d),
+            dv.reshape(*batch, tk, d),
+        )
 
 
 def _fits(q, k, bq: int, bk: int) -> bool:
-    """VMEM gate: resident = q/o blocks (f32) + full k and v (input dtype) +
-    score/prob tiles. Shapes must also tile evenly (pad upstream if not)."""
+    """VMEM gate: the worst-resident kernel is the dk/dv backward, which keeps the
+    full q and dO (plus k/v blocks and score tiles) in VMEM. Shapes must also tile
+    evenly (pad upstream if not)."""
     tq, d = q.shape[-2], q.shape[-1]
     tk = k.shape[-2]
     if tq % bq or tk % bk:
         return False
+    if tq % _BWD_BQ or tk % _BWD_BK:
+        return False
     itemsize = jnp.dtype(q.dtype).itemsize
-    resident = 4 * (3 * bq * d + 3 * bq * bk) + 2 * tk * d * itemsize
-    return resident <= 10 * 2**20
+    fwd = 4 * (3 * bq * d + 3 * bq * bk) + 2 * tk * d * itemsize
+    bwd = (
+        4 * (4 * _BWD_BQ * d + 3 * _BWD_BQ * _BWD_BK)
+        + 4 * max(tk, tq) * d * itemsize  # full q + dO resident in the dk/dv kernel
+    )
+    return max(fwd, bwd) <= 10 * 2**20
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -154,35 +325,42 @@ def flash_attention(q, k, v, causal: bool = False, scale=None):
     """Exact attention with the flash (streaming-VMEM) forward on TPU.
 
     q: (..., Tq, D), k/v: (..., Tk, D); Tq/Tk must be multiples of the 512-block
-    (callers fall back to the XLA path otherwise via :func:`use_flash`).
+    (callers fall back to the XLA path otherwise via :func:`use_flash`). The
+    backward is the flash backward (two Pallas kernels over the saved (O, LSE)
+    residuals) — neither direction ever materializes the (T, T) matrix in HBM.
     """
     s = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
     # f32 compute wins on this shape class: at head_dim 64 the kernel is VPU-bound
     # (exp + rescale on (bq,bk) tiles), and bf16 MXU passes don't pay for the extra
     # relayouts (measured 17.3 vs 15.0 TFLOP/s at b8·h16·t4096·d64 on v5e, 3× the
     # jax.experimental.pallas.ops.tpu library kernel on the same workload)
-    return _flash_pallas(q, k, v, causal, float(s), _BQ, _BK, compute_dtype=jnp.float32)
+    out, _ = _flash_pallas(q, k, v, causal, float(s), _BQ, _BK, compute_dtype=jnp.float32)
+    return out
 
 
 def _fwd(q, k, v, causal, scale):
-    return flash_attention(q, k, v, causal, scale), (q, k, v)
+    s = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+    out, lse = _flash_pallas(q, k, v, causal, float(s), _BQ, _BK, compute_dtype=jnp.float32)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, scale, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: flash_attention_reference(q_, k_, v_, causal, scale), q, k, v
-    )
-    return vjp(g)
+    q, k, v, out, lse = res
+    s = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
+    return _flash_bwd_pallas(q, k, v, out, g, lse, causal, float(s), _BWD_BQ, _BWD_BK)
 
 
 flash_attention.defvjp(_fwd, _bwd)
 
 
-def use_flash(q, k, v, mask, interpret: bool = False) -> bool:
+def use_flash(q, k, v, mask, scale=None, interpret: bool = False) -> bool:
     """True when the Pallas forward applies: TPU backend, no explicit mask, a
-    Mosaic-supported dtype, and shapes that fit the VMEM budget/tiling."""
+    static (or default) scale, a Mosaic-supported dtype, and shapes that fit the
+    VMEM budget/tiling."""
     if mask is not None:
+        return False
+    if scale is not None and not isinstance(scale, (int, float)):
+        # a traced scale can't become the kernel's static parameter; XLA path handles it
         return False
     # f64 inputs (legal framework-wide: x64 is enabled globally) must take the XLA
     # path — the kernel computes under enable_x64(False) and can't store to an f64 ref

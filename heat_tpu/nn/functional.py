@@ -324,9 +324,12 @@ def embedding(x, weight, padding_idx: Optional[int] = None):
     gradient (torch zeroes its grad every backward), so a zero-initialized padding
     row stays exactly zero for the whole training run."""
     v, proto = _unwrap(x)
-    if padding_idx is not None:
-        weight = weight.at[padding_idx].set(jax.lax.stop_gradient(weight[padding_idx]))
     out = jnp.take(weight, v.astype(jnp.int32), axis=0)
+    if padding_idx is not None:
+        # block exactly the cotangents that would scatter-add into the padding row —
+        # O(batch) masking instead of an O(vocab) copy of the weight per forward
+        idx = v.astype(jnp.int32) == padding_idx
+        out = jnp.where(idx[..., None], jax.lax.stop_gradient(out), out)
     if proto is not None:
         from ..core._operations import wrap_result
 

@@ -21,7 +21,7 @@ class TestFlashKernel:
         rng = np.random.default_rng(0)
         q, k, v = (jnp.array(rng.standard_normal(shape), jnp.float32) for _ in range(3))
         scale = 1.0 / np.sqrt(shape[-1])
-        got = _flash_pallas(q, k, v, causal, float(scale), 512, 512, interpret=True)
+        got, lse = _flash_pallas(q, k, v, causal, float(scale), 512, 512, interpret=True)
         want = flash_attention_reference(q, k, v, causal)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
 
@@ -31,7 +31,7 @@ class TestFlashKernel:
         q = jnp.array(rng.standard_normal((1, 1, 512, 64)), jnp.float32)
         k = jnp.array(rng.standard_normal((1, 1, 1536, 64)), jnp.float32)
         v = jnp.array(rng.standard_normal((1, 1, 1536, 64)), jnp.float32)
-        got = _flash_pallas(q, k, v, False, float(1 / np.sqrt(64)), 512, 512, interpret=True)
+        got, _ = _flash_pallas(q, k, v, False, float(1 / np.sqrt(64)), 512, 512, interpret=True)
         want = flash_attention_reference(q, k, v, False)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
 
@@ -44,8 +44,8 @@ class TestFlashKernel:
         v = jnp.array(rng.standard_normal((1, 1, 1024, 64)), jnp.float32)
         # queries in the first block attend only the first block of keys
         k_poison = k.at[:, :, 512:, :].set(1e4)
-        a = _flash_pallas(q, k, v, True, 0.125, 512, 512, interpret=True)
-        b = _flash_pallas(q, k_poison, v, True, 0.125, 512, 512, interpret=True)
+        a, _ = _flash_pallas(q, k, v, True, 0.125, 512, 512, interpret=True)
+        b, _ = _flash_pallas(q, k_poison, v, True, 0.125, 512, 512, interpret=True)
         np.testing.assert_allclose(
             np.asarray(a[:, :, :512]), np.asarray(b[:, :, :512]), rtol=1e-5, atol=1e-5
         )
@@ -66,3 +66,68 @@ class TestFlashKernel:
         q = jnp.zeros((1, 1, 512, 64), jnp.bfloat16)
         k = jnp.zeros((1, 1, 1 << 20, 64), jnp.bfloat16)  # 128 MB of k+v
         assert not use_flash(q, k, k, None, interpret=True)
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_bwd_interpret_parity(self, causal):
+        """Pallas backward (dq, dk, dv) matches autodiff of the dense reference."""
+        from heat_tpu.core.kernels.flash_attention import _flash_bwd_pallas
+
+        rng = np.random.default_rng(3)
+        shape = (1, 2, 1024, 64)
+        q, k, v = (jnp.array(rng.standard_normal(shape), jnp.float32) for _ in range(3))
+        g = jnp.array(rng.standard_normal(shape), jnp.float32)
+        scale = float(1.0 / np.sqrt(shape[-1]))
+
+        out, lse = _flash_pallas(q, k, v, causal, scale, 512, 512, interpret=True)
+        dq, dk, dv = _flash_bwd_pallas(
+            q, k, v, out, g, lse, causal, scale, 512, 512, interpret=True
+        )
+        _, vjp = jax.vjp(lambda a, b, c: flash_attention_reference(a, b, c, causal), q, k, v)
+        dq_r, dk_r, dv_r = vjp(g)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_r), rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(dk_r), rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_r), rtol=2e-3, atol=2e-3)
+
+    def test_bwd_cross_lengths_interpret(self):
+        from heat_tpu.core.kernels.flash_attention import _flash_bwd_pallas
+
+        rng = np.random.default_rng(4)
+        q = jnp.array(rng.standard_normal((1, 1, 512, 64)), jnp.float32)
+        k = jnp.array(rng.standard_normal((1, 1, 1024, 64)), jnp.float32)
+        v = jnp.array(rng.standard_normal((1, 1, 1024, 64)), jnp.float32)
+        g = jnp.array(rng.standard_normal((1, 1, 512, 64)), jnp.float32)
+        scale = 0.125
+        out, lse = _flash_pallas(q, k, v, False, scale, 512, 512, interpret=True)
+        dq, dk, dv = _flash_bwd_pallas(q, k, v, out, g, lse, False, scale, 512, 512, interpret=True)
+        _, vjp = jax.vjp(lambda a, b, c: flash_attention_reference(a, b, c, False, scale), q, k, v)
+        dq_r, dk_r, dv_r = vjp(g)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_r), rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(dk_r), rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_r), rtol=2e-3, atol=2e-3)
+
+    def test_lse_matches_reference(self):
+        rng = np.random.default_rng(5)
+        q, k, v = (jnp.array(rng.standard_normal((1, 1, 512, 64)), jnp.float32) for _ in range(3))
+        _, lse = _flash_pallas(q, k, v, False, 0.125, 512, 512, interpret=True)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.125
+        want = jax.nn.logsumexp(s, axis=-1)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(want), rtol=1e-4, atol=1e-4)
+
+
+class TestTracedScale:
+    def test_traced_scale_falls_back_to_xla(self):
+        """A traced scale can't be the kernel's static arg — gate must reject it,
+        and sdpa must still produce the right answer under jit."""
+        from heat_tpu.nn.attention import scaled_dot_product_attention as sdpa
+
+        q = jnp.zeros((1, 1, 1024, 64), jnp.float32)
+        assert not use_flash(q, q, q, None, scale=jnp.float32(0.125), interpret=True)
+        assert use_flash(q, q, q, None, scale=0.125, interpret=True)
+
+        rng = np.random.default_rng(6)
+        qv = jnp.array(rng.standard_normal((1, 1, 64, 16)), jnp.float32)
+        want = sdpa(qv, qv, qv, scale=0.25)
+        got = jax.jit(lambda a, s: sdpa(a, a, a, scale=s))(qv, jnp.float32(0.25))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6)
