@@ -328,6 +328,8 @@ def embedding(x, weight, padding_idx: Optional[int] = None):
     if padding_idx is not None:
         # block exactly the cotangents that would scatter-add into the padding row —
         # O(batch) masking instead of an O(vocab) copy of the weight per forward
+        if padding_idx < 0:  # torch normalizes negative indices
+            padding_idx = padding_idx + weight.shape[0]
         idx = v.astype(jnp.int32) == padding_idx
         out = jnp.where(idx[..., None], jax.lax.stop_gradient(out), out)
     if proto is not None:

@@ -176,6 +176,22 @@ class TestMNISTExample(TestCase):
         self.assertGreater(acc, 0.95)
 
 
+class TestTransformerLMExample(TestCase):
+    def test_lm_learns(self):
+        """The causal transformer LM example (MultiheadAttention + Embedding +
+        ModuleList) trains end to end and learns on the toy corpus."""
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples", "nn"))
+        try:
+            import transformer_lm
+        finally:
+            sys.path.pop(0)
+        final = transformer_lm.main(steps=120)
+        self.assertLess(final, 2.0)  # ~3.4 nats at init on this corpus
+
+
 class TestImagenetDASOExample(TestCase):
     def test_daso_example_smoke(self):
         """The hierarchical-DASO training example runs end to end and learns."""
