@@ -30,6 +30,17 @@ from .basics import PARITY_PRECISION, matmul, vector_norm
 __all__ = ["hsvd", "hsvd_rank", "hsvd_rtol"]
 
 
+def guarded_svd(x, full_matrices: bool = False, compute_uv: bool = True):
+    """``jnp.linalg.svd`` with the TPU x64 guard, shared by hsvd and the full
+    :func:`heat_tpu.linalg.svd`: the float32 SVD lowering SIGABRTs the TPU
+    compiler when global x64 mode is on (int64 index types), so the op is traced
+    in x32 scope there."""
+    if jax.default_backend() != "cpu" and x.dtype == jnp.float32:
+        with jax.enable_x64(False):
+            return jnp.linalg.svd(x, full_matrices=full_matrices, compute_uv=compute_uv)
+    return jnp.linalg.svd(x, full_matrices=full_matrices, compute_uv=compute_uv)
+
+
 def hsvd_rank(
     A: DNDarray,
     maxrank: int,
@@ -224,13 +235,7 @@ def _batched_truncated_svd(
             for b in blocks
         ]
     )
-    if jax.default_backend() != "cpu" and stacked.dtype == jnp.float32:
-        # TPU workaround: the float32 SVD lowering SIGABRTs the TPU compiler when
-        # global x64 mode is on (int64 index types); trace this op in x32 scope
-        with jax.enable_x64(False):
-            u, s, _ = jnp.linalg.svd(stacked, full_matrices=False)
-    else:
-        u, s, _ = jnp.linalg.svd(stacked, full_matrices=False)
+    u, s, _ = guarded_svd(stacked)
     noiselevel = 1e-14 if stacked.dtype == jnp.float64 else 1e-7
     s_all = np.asarray(s)  # the level's single host sync
 

@@ -162,14 +162,3 @@ class TestProductionVJPPath:
         )(q, k, v)
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3)
-
-    def test_negative_padding_idx_blocks_grad(self):
-        import heat_tpu as ht
-
-        emb = ht.nn.Embedding(6, 3, padding_idx=-1)
-        params = emb.init(jax.random.key(0))
-        assert np.allclose(np.asarray(params["weight"][5]), 0.0)
-        idx = jnp.array([5, 1, 5, 2])  # token 5 IS the (normalized) padding row
-        g = jax.grad(lambda p: jnp.sum(emb.apply(p, idx) ** 2))(params)
-        assert np.allclose(np.asarray(g["weight"][5]), 0.0)
-        assert bool(jnp.any(g["weight"][1] != 0))

@@ -133,9 +133,59 @@ class TestHSVD(TestCase):
         rel = np.linalg.norm(An - recon) / np.linalg.norm(An)
         self.assertLess(rel, 1e-2)
 
-    def test_svd_stub(self):
+    def test_svd_replicated(self):
+        """Full reduced SVD — implemented here although the reference stubs it."""
+        rng = np.random.default_rng(11)
+        an = rng.standard_normal((12, 8)).astype(np.float32)
+        u, s, vh = ht.linalg.svd(ht.array(an))
+        recon = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(recon, an, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            s.numpy(), np.linalg.svd(an, compute_uv=False), rtol=1e-4, atol=1e-4
+        )
+
+    def test_svd_tall_skinny_split0(self):
+        """The TSQR path: split-0 tall-skinny, U keeps the row split."""
+        rng = np.random.default_rng(12)
+        n = ht.get_comm().size
+        an = rng.standard_normal((32 * n, 6)).astype(np.float32)
+        a = ht.array(an, split=0)
+        u, s, vh = ht.linalg.svd(a)
+        self.assertEqual(u.split, 0)
+        self.assertIsNone(s.split)
+        un = u.numpy()
+        recon = un @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(recon, an, rtol=1e-3, atol=1e-3)
+        # U orthonormal
+        np.testing.assert_allclose(un.T @ un, np.eye(un.shape[1]), rtol=1e-3, atol=1e-3)
+        # singular values match numpy
+        np.testing.assert_allclose(
+            s.numpy(), np.linalg.svd(an, compute_uv=False), rtol=1e-3, atol=1e-3
+        )
+
+    def test_svd_short_fat_split1(self):
+        """Short-fat arrays factor the transpose; Vh.T keeps the column split's role."""
+        rng = np.random.default_rng(13)
+        n = ht.get_comm().size
+        an = rng.standard_normal((6, 32 * n)).astype(np.float32)
+        a = ht.array(an, split=1)
+        u, s, vh = ht.linalg.svd(a)
+        recon = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(recon, an, rtol=1e-3, atol=1e-3)
+
+    def test_svd_compute_uv_false(self):
+        rng = np.random.default_rng(14)
+        an = rng.standard_normal((20, 5)).astype(np.float32)
+        s = ht.linalg.svd(ht.array(an, split=0), compute_uv=False)
+        np.testing.assert_allclose(
+            s.numpy(), np.linalg.svd(an, compute_uv=False), rtol=1e-4, atol=1e-4
+        )
+
+    def test_svd_errors(self):
         with self.assertRaises(NotImplementedError):
-            ht.linalg.svd(ht.ones((4, 4)))
+            ht.linalg.svd(ht.ones((4, 4)), full_matrices=True)
+        with self.assertRaises(ValueError):
+            ht.linalg.svd(ht.ones(5))
 
     def test_hsvd_errors(self):
         with self.assertRaises(RuntimeError):

@@ -383,6 +383,16 @@ class TestReviewRegressions:
         assert np.allclose(_np(g["weight"][0]), 0.0)
         assert bool(jnp.any(g["weight"][1] != 0))
 
+    def test_negative_padding_idx_blocks_grad(self):
+        """torch normalizes a negative padding_idx; the gradient mask must too."""
+        emb = ht.nn.Embedding(6, 3, padding_idx=-1)
+        params = emb.init(jax.random.key(0))
+        assert np.allclose(_np(params["weight"][5]), 0.0)
+        idx = jnp.array([5, 1, 5, 2])  # token 5 IS the (normalized) padding row
+        g = jax.grad(lambda p: jnp.sum(emb.apply(p, idx) ** 2))(params)
+        assert np.allclose(_np(g["weight"][5]), 0.0)
+        assert bool(jnp.any(g["weight"][1] != 0))
+
     def test_smooth_l1_beta_zero_is_l1_with_finite_grad(self):
         p = jnp.array([1.0, -2.0, 0.0])
         t = jnp.array([0.5, -2.0, 1.0])
