@@ -131,9 +131,10 @@ class CosineAnnealingLR(LRScheduler):
         super().__init__(optimizer, last_epoch)
 
     def get_lr(self) -> float:
-        t = min(self.last_epoch, self.T_max)
+        # torch does NOT clamp at T_max: the cosine keeps evolving, so the lr
+        # climbs back up after the trough (periodic annealing)
         return self.eta_min + (self.base_lr - self.eta_min) * (
-            1 + math.cos(math.pi * t / self.T_max)
+            1 + math.cos(math.pi * self.last_epoch / self.T_max)
         ) / 2
 
 

@@ -1,0 +1,82 @@
+"""Indexing parity fuzz: every getitem/setitem expression below must match numpy
+for every split — the exhaustive counterpart of the reference's hand-written
+advanced-indexing tests (reference heat/core/tests/test_dndarray.py:828+)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+rng = np.random.default_rng(0)
+SHAPE = (11, 7, 5)
+BASE = rng.standard_normal(SHAPE).astype(np.float32)
+
+GET_CASES = [
+    (slice(None),),
+    (slice(2, 9),),
+    (slice(None, None, 2),),
+    (slice(None, None, -1),),
+    (slice(8, 2, -2),),
+    (3,),
+    (-1,),
+    (slice(None), 4),
+    (slice(None), slice(1, 6, 2), 3),
+    (Ellipsis, 2),
+    (None, slice(None)),
+    (slice(None), None, 2),
+    ([0, 3, 5],),
+    (np.array([0, 3, 5]),),
+    (np.array([[0, 1], [2, 3]]),),
+    (slice(None), [0, 2], slice(None)),
+    ([1, 2], [0, 1]),
+    ([1, 2], slice(None), [0, 1]),
+    (BASE > 0.5,),
+    (BASE[:, :, 0] > 0.5,),
+    (np.array([True, False] * 5 + [True]),),
+    (slice(None), np.array([1, 5, 3]), 2),
+    (2, [0, 1, 2]),
+    (slice(3, 3),),
+    (np.array([], dtype=np.int64),),
+]
+
+SET_CASES = [
+    ((slice(2, 5),), 7.0),
+    ((slice(None), 3), 1.5),
+    ((slice(None, None, 2),), 0.0),
+    (([0, 2, 4],), 9.0),
+    ((BASE > 1.0,), 0.0),
+    ((2, slice(1, 4)), np.arange(5, dtype=np.float32)),  # broadcasts over (3, 5)
+    ((slice(0, 4),), rng.standard_normal((4, 7, 5)).astype(np.float32)),
+]
+
+
+def _key(idx):
+    return idx[0] if len(idx) == 1 else idx
+
+
+@pytest.mark.parametrize("split", [None, 0, 1, 2])
+class TestGetitemFuzz:
+    def test_all_cases(self, split):
+        a = ht.array(BASE, split=split)
+        for idx in GET_CASES:
+            key = _key(idx)
+            want = BASE[key]
+            got = a[key]
+            gotn = got.numpy() if isinstance(got, ht.DNDarray) else np.asarray(got)
+            assert gotn.shape == want.shape, f"shape mismatch for {key!r} at split={split}"
+            np.testing.assert_allclose(gotn, want, rtol=1e-6, err_msg=f"{key!r} split={split}")
+
+
+@pytest.mark.parametrize("split", [None, 0, 1, 2])
+class TestSetitemFuzz:
+    def test_all_cases(self, split):
+        for idx, val in SET_CASES:
+            key = _key(idx)
+            want = BASE.copy()
+            want[key] = val
+            a = ht.array(BASE, split=split)
+            a[key] = val
+            np.testing.assert_allclose(
+                a.numpy(), want, rtol=1e-6, err_msg=f"{key!r} split={split}"
+            )
+            assert a.split == split  # setitem preserves the distribution

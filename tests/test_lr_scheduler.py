@@ -1,0 +1,97 @@
+"""lr_scheduler torch-parity: each scheduler's lr trajectory over 25 epochs must
+match torch.optim.lr_scheduler exactly (the reference wraps every torch scheduler
+via fall-through, heat/optim/lr_scheduler.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.optim import lr_scheduler as hls
+
+torch = pytest.importorskip("torch")
+
+
+class _FakeOpt:
+    """Minimal optimizer: the schedulers only need a mutable ``lr``."""
+
+    def __init__(self, lr=0.1):
+        self.lr = lr
+
+
+def _torch_opt(lr=0.1):
+    return torch.optim.SGD([torch.nn.Parameter(torch.zeros(1))], lr=lr)
+
+
+def _trajectories(ours, theirs, epochs=25):
+    got, want = [], []
+    for _ in range(epochs):
+        got.append(float(ours.get_last_lr()[0]))
+        want.append(theirs.get_last_lr()[0])
+        ours.step()
+        theirs.step()
+    return got, want
+
+
+class TestSchedulerParity:
+    @pytest.mark.parametrize(
+        "ours_f,theirs_f",
+        [
+            (
+                lambda o: hls.StepLR(o, step_size=5, gamma=0.5),
+                lambda t: torch.optim.lr_scheduler.StepLR(t, step_size=5, gamma=0.5),
+            ),
+            (
+                lambda o: hls.MultiStepLR(o, milestones=[3, 7, 15], gamma=0.1),
+                lambda t: torch.optim.lr_scheduler.MultiStepLR(t, milestones=[3, 7, 15], gamma=0.1),
+            ),
+            (
+                lambda o: hls.ExponentialLR(o, gamma=0.9),
+                lambda t: torch.optim.lr_scheduler.ExponentialLR(t, gamma=0.9),
+            ),
+            (
+                lambda o: hls.CosineAnnealingLR(o, T_max=10),
+                lambda t: torch.optim.lr_scheduler.CosineAnnealingLR(t, T_max=10),
+            ),
+            (
+                lambda o: hls.ConstantLR(o, factor=0.5, total_iters=4),
+                lambda t: torch.optim.lr_scheduler.ConstantLR(t, factor=0.5, total_iters=4),
+            ),
+            (
+                lambda o: hls.LinearLR(o, start_factor=1.0 / 3, total_iters=8),
+                lambda t: torch.optim.lr_scheduler.LinearLR(t, start_factor=1.0 / 3, total_iters=8),
+            ),
+            (
+                lambda o: hls.LambdaLR(o, lambda e: 0.95**e),
+                lambda t: torch.optim.lr_scheduler.LambdaLR(t, lambda e: 0.95**e),
+            ),
+        ],
+        ids=["StepLR", "MultiStepLR", "ExponentialLR", "CosineAnnealingLR",
+             "ConstantLR", "LinearLR", "LambdaLR"],
+    )
+    def test_trajectory(self, ours_f, theirs_f):
+        got, want = _trajectories(ours_f(_FakeOpt()), theirs_f(_torch_opt()))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_reduce_on_plateau(self):
+        ours = hls.ReduceLROnPlateau(_FakeOpt(), factor=0.5, patience=2)
+        tt = torch.optim.lr_scheduler.ReduceLROnPlateau(_torch_opt(), factor=0.5, patience=2)
+        metrics = [1.0, 0.9, 0.9, 0.9, 0.9, 0.8, 0.8, 0.8, 0.8, 0.8, 0.8]
+        got, want = [], []
+        for m in metrics:
+            ours.step(m)
+            tt.step(m)
+            got.append(float(ours.get_last_lr()[0]))
+            want.append(tt.get_last_lr()[0])
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_drives_real_optimizer(self):
+        """The scheduler actually changes the lr the DataParallelOptimizer uses."""
+        import jax.numpy as jnp
+
+        model = ht.nn.Sequential(ht.nn.Linear(2, 2))
+        opt = ht.optim.DataParallelOptimizer("sgd", lr=0.5)
+        ht.nn.DataParallel(model, optimizer=opt)
+        sched = hls.StepLR(opt, step_size=1, gamma=0.1)
+        assert abs(float(opt.lr) - 0.5) < 1e-9
+        sched.step()
+        assert abs(float(opt.lr) - 0.05) < 1e-9
