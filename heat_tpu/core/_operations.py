@@ -22,6 +22,7 @@ from typing import Callable, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from . import sanitation, types
@@ -67,6 +68,25 @@ def handle_out(res: DNDarray, out: Optional[DNDarray], proto: DNDarray) -> DNDar
     sanitation.sanitize_out(out, res.gshape, res.split, proto.device)
     out.larray = proto.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
     return out
+
+
+def _complex_host_route(*vals):
+    """When an op's result type is complex and the accelerator can't hold complex
+    values (devices.accelerator_capabilities — one failed attempt poisons the
+    process), move the inputs to host CPU and run there. This also makes mixed
+    host-complex × accelerator-real operands computable (eager jax refuses
+    differently-committed inputs). Returns ``(vals, context_manager)``."""
+    from contextlib import nullcontext
+
+    from .devices import complex_needs_host, cpu_fallback_device
+
+    if not complex_needs_host(*vals):
+        return vals, nullcontext()
+    cpu = cpu_fallback_device()
+    moved = tuple(
+        jax.device_put(v, cpu) if isinstance(v, jax.Array) else v for v in vals
+    )
+    return moved, jax.default_device(cpu)
 
 
 def _out_split_binary(out_shape: Tuple[int, ...], *operands: DNDarray) -> Optional[int]:
@@ -118,12 +138,17 @@ def binary_op(
     # promote: scalars stay weakly typed so jnp's promotion matches numpy/heat
     x1 = a.larray if not np.isscalar(t1) else t1
     x2 = b.larray if not np.isscalar(t2) else t2
-    result = operation(x1, x2, **fn_kwargs)
+    (x1, x2), ctx = _complex_host_route(x1, x2)
+    with ctx:
+        result = operation(x1, x2, **fn_kwargs)
 
-    if where is not None:
-        w = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
-        base = out.larray if out is not None else jnp.zeros(out_shape, result.dtype)
-        result = jnp.where(w, result, base)
+        if where is not None:
+            w = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
+            (w, base_src), _ = _complex_host_route(
+                w, out.larray if out is not None else result
+            )
+            base = base_src if out is not None else jnp.zeros(out_shape, result.dtype)
+            result = jnp.where(w, result, base)
 
     use_comm = comm or get_comm()
     if out is not None:

@@ -234,6 +234,19 @@ class MeshCommunication(Communication):
         Divisible dims go through ``device_put`` (no compilation); ragged dims go through
         a jitted ``with_sharding_constraint``, which GSPMD supports via internal padding.
         """
+        if jnp.issubdtype(getattr(array, "dtype", None), jnp.complexfloating):
+            from .devices import complex_needs_host, cpu_fallback_device
+
+            if (
+                complex_needs_host(array.dtype)
+                and self._devices
+                and self._devices[0].platform != "cpu"
+            ):
+                # the accelerator cannot hold complex values (see
+                # devices.accelerator_capabilities); complex arrays live on host CPU,
+                # un-sharded — on such systems the accelerator mesh is the wrong home
+                # for this dtype and the split is metadata only
+                return jax.device_put(array, cpu_fallback_device())
         target = self.sharding(array.ndim, split)
         if isinstance(array, jax.Array) and array.sharding == target:
             return array

@@ -100,3 +100,107 @@ def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
         if dev in ("cpu", "tpu", "gpu", "axon"):
             return Device(dev)
     raise ValueError(f"Unknown device, must be 'cpu', 'tpu' or 'gpu', got {device!r}")
+
+
+# --------------------------------------------------------------- capability probe
+_ACCEL_CAPS = None
+
+
+def accelerator_capabilities() -> dict:
+    """Capabilities of the default accelerator backend: ``{"complex": bool,
+    "fft": bool}`` (always both True on CPU).
+
+    Some TPU runtimes cannot hold complex values or lower FFT HLOs at all — and a
+    failed attempt POISONS the issuing process's backend (observed: after one
+    UNIMPLEMENTED complex/fft op, every later op including plain f32 reductions
+    fails). The probe therefore runs in a subprocess, once, and is cached.
+    Overrides: HEAT_TPU_COMPLEX_BACKEND=cpu|device, HEAT_TPU_FFT_BACKEND=cpu|device.
+    """
+    global _ACCEL_CAPS
+    if _ACCEL_CAPS is not None:
+        return _ACCEL_CAPS
+    import os
+
+    caps = {}
+    forced_c = os.environ.get("HEAT_TPU_COMPLEX_BACKEND")
+    forced_f = os.environ.get("HEAT_TPU_FFT_BACKEND")
+    if forced_c:
+        caps["complex"] = forced_c == "device"
+    if forced_f:
+        caps["fft"] = forced_f == "device"
+    if len(caps) < 2:
+        if jax.default_backend() == "cpu":
+            caps.setdefault("complex", True)
+            caps.setdefault("fft", True)
+        else:
+            import subprocess
+            import sys
+
+            # the child must land on the SAME accelerator platform as the parent —
+            # on exclusively-locked devices it may fail to initialize (or silently
+            # fall back to CPU, which would report false support); both cases are
+            # treated as "unsupported", which is slow-but-safe (host execution)
+            # rather than process-poisoning
+            parent_platform = jax.devices()[0].platform
+            code = (
+                "import jax, jax.numpy as jnp, numpy as np\n"
+                f"assert jax.devices()[0].platform == {parent_platform!r}\n"
+                "ok_c = ok_f = False\n"
+                "try:\n"
+                "    np.asarray(jnp.array(np.ones(4, np.complex64)) + 1j); ok_c = True\n"
+                "except Exception: pass\n"
+                "try:\n"
+                "    np.asarray(jnp.fft.fft(jnp.ones(4, jnp.complex64))); ok_f = True\n"
+                "except Exception: pass\n"
+                "print('CAPS', int(ok_c), int(ok_f))\n"
+            )
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", code], capture_output=True, timeout=180, text=True
+                )
+                line = next(
+                    (l for l in proc.stdout.splitlines() if l.startswith("CAPS")), "CAPS 0 0"
+                )
+                _, c, f = line.split()
+                caps.setdefault("complex", bool(int(c)))
+                caps.setdefault("fft", bool(int(f)))
+            except Exception:
+                caps.setdefault("complex", False)
+                caps.setdefault("fft", False)
+    _ACCEL_CAPS = caps
+    return caps
+
+
+def complex_supported() -> bool:
+    """Whether the default accelerator holds complex values (see
+    :func:`accelerator_capabilities`)."""
+    return accelerator_capabilities()["complex"]
+
+
+def cpu_fallback_device() -> jax.Device:
+    """The host CPU device complex values live on when the accelerator can't hold
+    them."""
+    return jax.local_devices(backend="cpu")[0]
+
+
+def complex_needs_host(*dtypes_or_values) -> bool:
+    """True when a value of the promoted dtype of ``dtypes_or_values`` cannot live
+    on the default accelerator (complex unsupported there) — the single predicate
+    behind every complex→host fallback site."""
+    if jax.default_backend() == "cpu":
+        return False
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        rt = np.result_type(
+            *[getattr(v, "dtype", v) for v in dtypes_or_values]
+        ) if dtypes_or_values else None
+    except Exception:
+        try:
+            rt = jnp.result_type(*dtypes_or_values)
+        except Exception:
+            return False
+    if rt is None or not np.issubdtype(rt, np.complexfloating):
+        return False
+    return not complex_supported()

@@ -42,6 +42,20 @@ __all__ = [
 ]
 
 
+def _complex_to_host(value, target_dtype=None):
+    """When the accelerator can't hold complex values (the failed attempt poisons
+    the process — see devices.accelerator_capabilities), values that are or are
+    about to become complex move to the host CPU. All factory paths converge here
+    through ``_wrap``."""
+    from .devices import complex_needs_host, cpu_fallback_device
+
+    if complex_needs_host(target_dtype if target_dtype is not None else value):
+        dev = getattr(value, "device", None)
+        if dev is None or getattr(dev, "platform", "cpu") != "cpu":
+            return jax.device_put(value, cpu_fallback_device())
+    return value
+
+
 def _wrap(
     value: jax.Array,
     dtype: Optional[Type[types.datatype]],
@@ -55,6 +69,9 @@ def _wrap(
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
         if value.dtype != np.dtype(dtype.jax_type()):
+            # an accelerator-resident cast to complex would run on-device:
+            # move to host first when the accelerator can't hold complex
+            value = _complex_to_host(value, target_dtype=np.dtype(dtype.jax_type()))
             value = value.astype(dtype.jax_type())
     else:
         dtype = types.canonical_heat_type(value.dtype)
@@ -128,7 +145,15 @@ def array(
             ):
                 # python floats default to the framework float type (f32), like torch/heat
                 np_value = np_value.astype(np.float32)
-            value = jnp.asarray(np_value)
+            from .devices import complex_needs_host, cpu_fallback_device
+
+            if complex_needs_host(np_value.dtype):
+                # the accelerator can't even materialize complex values (and the
+                # failed attempt poisons the process); create on host CPU —
+                # comm.shard keeps this dtype there
+                value = jax.device_put(np_value, cpu_fallback_device())
+            else:
+                value = jnp.asarray(np_value)
 
     while value.ndim < ndmin:
         value = value[jnp.newaxis]
@@ -184,6 +209,14 @@ def __factory(shape, dtype, split, maker, device, comm, order="C") -> DNDarray:
     """Shared logic of empty/ones/zeros/full (reference ``factories.py:699``)."""
     shape = sanitize_shape(shape)
     dtype = types.canonical_heat_type(dtype)
+    from .devices import complex_needs_host, cpu_fallback_device
+
+    if complex_needs_host(np.dtype(dtype.jax_type())):
+        # create on host outright: even materializing complex on such an
+        # accelerator poisons the process (devices.accelerator_capabilities)
+        with jax.default_device(cpu_fallback_device()):
+            value = maker(shape, dtype=dtype.jax_type())
+        return _wrap(value, dtype, split, device, comm)
     value = maker(shape, dtype=dtype.jax_type())
     return _wrap(value, dtype, split, device, comm)
 
@@ -206,13 +239,28 @@ def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="
 
 def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Constant fill (reference ``factories.py:957``)."""
+    from contextlib import nullcontext
+
+    from .devices import complex_needs_host, cpu_fallback_device
+
     shape = sanitize_shape(shape)
-    if dtype is None:
-        value = jnp.full(shape, fill_value)
-        if value.dtype == jnp.float64 and isinstance(fill_value, float):
-            value = value.astype(jnp.float32)
-    else:
-        value = jnp.full(shape, fill_value, dtype=types.canonical_heat_type(dtype).jax_type())
+    target = (
+        np.result_type(fill_value)
+        if dtype is None
+        else np.dtype(types.canonical_heat_type(dtype).jax_type())
+    )
+    ctx = (
+        jax.default_device(cpu_fallback_device())
+        if complex_needs_host(target)
+        else nullcontext()
+    )
+    with ctx:
+        if dtype is None:
+            value = jnp.full(shape, fill_value)
+            if value.dtype == jnp.float64 and isinstance(fill_value, float):
+                value = value.astype(jnp.float32)
+        else:
+            value = jnp.full(shape, fill_value, dtype=types.canonical_heat_type(dtype).jax_type())
     return _wrap(value, dtype, split, device, comm)
 
 

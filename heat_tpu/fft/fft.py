@@ -2,12 +2,12 @@
 
 The reference's strategy (``__fft_op`` ``fft.py:40-137``): a transform along a non-split
 axis is purely local torch.fft; a transform along the split axis is a *pencil
-decomposition* — transpose the axis to 0, ``resplit(1)``, transform locally,
-``resplit_(0)``, transpose back. On TPU the pencil dance is exactly what XLA SPMD emits
-for an FFT over a sharded dimension (all-to-all re-layout, local FFT, all-to-all back),
-so every wrapper here is one ``jnp.fft`` call plus split bookkeeping: real/complex
-transforms that change the last-axis length keep the split unless it sits on the
-transformed axis, in which case the output stays sharded the same way the input was.
+decomposition* — move the distribution to another axis (all-to-all resplit), transform
+locally, resplit back. The TPU build keeps that pencil explicit (``_pencil_split``):
+handing XLA an FFT over a sharded axis trips a hard CHECK in its SPMD partitioner
+(``fft_handler.cc``: per-partition size divisibility) that aborts the whole process,
+so the resplit-first schedule is a correctness requirement, not a tuning choice.
+Transforms along unsplit axes are one local ``jnp.fft`` call plus split bookkeeping.
 """
 
 from __future__ import annotations
@@ -16,6 +16,7 @@ from typing import Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..core import types
@@ -50,11 +51,58 @@ __all__ = [
 ]
 
 
+def _pencil_split(x: DNDarray, transformed: Tuple[int, ...]) -> Optional[int]:
+    """The reference's pencil decomposition (``fft.py:100-126``): a transform along
+    the split axis first moves the distribution to an untransformed axis (resplit =
+    all-to-all), falling back to full replication when every axis is transformed.
+
+    This is mandatory, not an optimisation: XLA's SPMD FFT partitioner hard-CHECKs
+    ``size_per_partition % num_partitions == 0`` (fft_handler.cc) and *aborts the
+    process* when a sharded transform axis doesn't satisfy it.
+    """
+    for ax in range(x.ndim):
+        if ax not in transformed:
+            return ax
+    return None
+
+
+def _fft_backend_supported() -> bool:
+    """Whether the default accelerator backend lowers FFT (some TPU runtimes report
+    UNIMPLEMENTED for every fft HLO — and the failed compile poisons the issuing
+    process). Delegates to the shared subprocess capability probe in
+    :func:`heat_tpu.core.devices.accelerator_capabilities`; override with
+    HEAT_TPU_FFT_BACKEND=cpu|device."""
+    from ..core.devices import accelerator_capabilities
+
+    return accelerator_capabilities()["fft"]
+
+
+def _run_fft(op, value, **kw):
+    """Run one jnp.fft op, falling back to the host CPU backend when the
+    accelerator cannot lower FFT (the result is re-sharded by the caller's
+    wrap_result, so distribution semantics are unchanged — only the transform
+    itself executes on host)."""
+    if _fft_backend_supported():
+        return op(value, **kw)
+    from ..core.devices import cpu_fallback_device
+
+    cpu = cpu_fallback_device()
+    with jax.default_device(cpu):
+        return op(jax.device_put(value, cpu), **kw)
+
+
 def _fft_op(x: DNDarray, op, n=None, axis=-1, norm=None) -> DNDarray:
     """Single-axis transform (reference ``__fft_op`` ``fft.py:40``)."""
     sanitize_in(x)
     axis = sanitize_axis(x.gshape, axis)
-    result = op(x.larray, n=n, axis=axis, norm=norm)
+    if x.split == axis and x.is_distributed():
+        from ..core.manipulations import resplit
+
+        tmp = _pencil_split(x, (axis,))
+        xr = resplit(x, tmp)
+        result = _run_fft(op, xr.larray, n=n, axis=axis, norm=norm)
+        return resplit(wrap_result(result, xr, tmp), x.split)
+    result = _run_fft(op, x.larray, n=n, axis=axis, norm=norm)
     return wrap_result(result, x, x.split)
 
 
@@ -63,7 +111,21 @@ def _fftn_op(x: DNDarray, op, s=None, axes=None, norm=None) -> DNDarray:
     sanitize_in(x)
     if axes is not None:
         axes = tuple(sanitize_axis(x.gshape, ax) for ax in axes)
-    result = op(x.larray, s=s, axes=axes, norm=norm)
+    if axes is not None:
+        transformed = axes
+    elif s is not None:
+        # numpy _cook_nd_args: s without axes transforms the LAST len(s) axes
+        transformed = tuple(range(x.ndim - len(tuple(s)), x.ndim))
+    else:
+        transformed = tuple(range(x.ndim))
+    if x.split is not None and x.split in transformed and x.is_distributed():
+        from ..core.manipulations import resplit
+
+        tmp = _pencil_split(x, transformed)
+        xr = resplit(x, tmp)
+        result = _run_fft(op, xr.larray, s=s, axes=axes, norm=norm)
+        return resplit(wrap_result(result, xr, tmp), x.split)
+    result = _run_fft(op, x.larray, s=s, axes=axes, norm=norm)
     return wrap_result(result, x, x.split)
 
 
@@ -79,12 +141,14 @@ def ifft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[st
 
 def fft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
     """2-D DFT (reference ``fft.py:293``)."""
-    return _fftn_op(x, jnp.fft.fft2, s, axes, norm)
+    # numpy: an explicit axes=None means ALL axes (fftn semantics), not the last two
+    return _fftn_op(x, jnp.fft.fftn, s, axes, norm) if axes is None else _fftn_op(x, jnp.fft.fft2, s, axes, norm)
 
 
 def ifft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
     """Inverse 2-D DFT (reference ``fft.py:502``)."""
-    return _fftn_op(x, jnp.fft.ifft2, s, axes, norm)
+    # numpy: an explicit axes=None means ALL axes (ifftn semantics), not the last two
+    return _fftn_op(x, jnp.fft.ifftn, s, axes, norm) if axes is None else _fftn_op(x, jnp.fft.ifft2, s, axes, norm)
 
 
 def fftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarray:
@@ -113,12 +177,14 @@ def rfft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[
     """2-D real DFT (reference ``fft.py:874``)."""
     if types.heat_type_is_complexfloating(x.dtype):
         raise TypeError("rfft2 requires a real input; use fft2 for complex data")
-    return _fftn_op(x, jnp.fft.rfft2, s, axes, norm)
+    # numpy: an explicit axes=None means ALL axes (rfftn semantics), not the last two
+    return _fftn_op(x, jnp.fft.rfftn, s, axes, norm) if axes is None else _fftn_op(x, jnp.fft.rfft2, s, axes, norm)
 
 
 def irfft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
     """Inverse 2-D real DFT (reference ``fft.py:684``)."""
-    return _fftn_op(x, jnp.fft.irfft2, s, axes, norm)
+    # numpy: an explicit axes=None means ALL axes (irfftn semantics), not the last two
+    return _fftn_op(x, jnp.fft.irfftn, s, axes, norm) if axes is None else _fftn_op(x, jnp.fft.irfft2, s, axes, norm)
 
 
 def rfftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarray:
@@ -145,7 +211,7 @@ def ihfft(x: DNDarray, n: Optional[int] = None, axis: int = -1, norm: Optional[s
 
 def hfft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
     """2-D Hermitian DFT (reference ``fft.py:416``)."""
-    return hfftn(x, s=s, axes=axes, norm=norm)
+    return hfftn(x, s=s, axes=axes, norm=norm)  # axes=None -> all axes, numpy semantics
 
 
 def hfftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarray:
@@ -154,17 +220,16 @@ def hfftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarra
     sanitize_in(x)
     if axes is not None:
         axes = tuple(sanitize_axis(x.gshape, ax) for ax in axes)
-    xv = jnp.conj(x.larray)
     # hfftn(x, norm) == irfftn(conj(x), norm-swapped): "backward" applies no forward
     # scaling, which is irfftn's "forward" behaviour (numpy hfft = irfft(conj(a), n)*n)
     inv = {None: "forward", "backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
-    result = jnp.fft.irfftn(xv, s=s, axes=axes, norm=inv)
-    return wrap_result(result, x, x.split)
+    op = lambda v, s=None, axes=None, norm=None: jnp.fft.irfftn(jnp.conj(v), s=s, axes=axes, norm=norm)
+    return _fftn_op(x, op, s, axes, inv)
 
 
 def ihfft2(x: DNDarray, s=None, axes: Tuple[int, int] = (-2, -1), norm: Optional[str] = None) -> DNDarray:
     """Inverse 2-D Hermitian DFT (reference ``fft.py:605``)."""
-    return ihfftn(x, s=s, axes=axes, norm=norm)
+    return ihfftn(x, s=s, axes=axes, norm=norm)  # axes=None -> all axes, numpy semantics
 
 
 def ihfftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarray:
@@ -175,8 +240,8 @@ def ihfftn(x: DNDarray, s=None, axes=None, norm: Optional[str] = None) -> DNDarr
     if axes is not None:
         axes = tuple(sanitize_axis(x.gshape, ax) for ax in axes)
     inv = {None: "forward", "backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
-    result = jnp.conj(jnp.fft.rfftn(x.larray, s=s, axes=axes, norm=inv))
-    return wrap_result(result, x, x.split)
+    op = lambda v, s=None, axes=None, norm=None: jnp.conj(jnp.fft.rfftn(v, s=s, axes=axes, norm=norm))
+    return _fftn_op(x, op, s, axes, inv)
 
 
 def fftfreq(n: int, d: float = 1.0, dtype=None, split: Optional[int] = None, device=None, comm=None) -> DNDarray:
