@@ -66,8 +66,29 @@ def handle_out(res: DNDarray, out: Optional[DNDarray], proto: DNDarray) -> DNDar
     if out is None:
         return res
     sanitation.sanitize_out(out, res.gshape, res.split, proto.device)
-    out.larray = proto.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
+    out.larray = proto.comm.shard(_safe_astype(res.larray, out.dtype.jax_type()), out.split)
     return out
+
+
+def _on_accelerator(value) -> bool:
+    """True when any of the array's committed devices is a non-CPU device.
+    (``array.device`` returns a NamedSharding for mesh-committed arrays, so a
+    ``.platform`` check on it silently passes — use the device set instead.)"""
+    try:
+        return any(d.platform != "cpu" for d in value.devices())
+    except Exception:
+        return True  # unknown placement: moving is the safe choice
+
+
+def _safe_astype(value, jax_dtype):
+    """``value.astype(jax_dtype)`` that first moves the value to host when the
+    target dtype can't live on the accelerator (an on-device cast to complex is
+    itself the poisoning op — devices.accelerator_capabilities)."""
+    from .devices import complex_needs_host, cpu_fallback_device
+
+    if complex_needs_host(jax_dtype) and _on_accelerator(value):
+        value = jax.device_put(value, cpu_fallback_device())
+    return value.astype(jax_dtype)
 
 
 def _complex_host_route(*vals):
@@ -119,7 +140,9 @@ def binary_op(
     (reference ``__binary_op`` ``_operations.py:22``)."""
     fn_kwargs = fn_kwargs or {}
     if np.isscalar(t1) and np.isscalar(t2) and out is None and where is None:
-        res = operation(jnp.asarray(t1), jnp.asarray(t2), **fn_kwargs)
+        (t1r, t2r), ctx = _complex_host_route(t1, t2)
+        with ctx:
+            res = operation(jnp.asarray(t1r), jnp.asarray(t2r), **fn_kwargs)
         from . import factories
 
         return factories.array(res)
@@ -144,16 +167,20 @@ def binary_op(
 
         if where is not None:
             w = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
-            (w, base_src), _ = _complex_host_route(
-                w, out.larray if out is not None else result
-            )
-            base = base_src if out is not None else jnp.zeros(out_shape, result.dtype)
-            result = jnp.where(w, result, base)
+            if out is not None:
+                (w, result, base), ctx2 = _complex_host_route(w, result, out.larray)
+            else:
+                (w, result), ctx2 = _complex_host_route(w, result)
+                base = None
+            with ctx2:
+                if base is None:
+                    base = jnp.zeros(out_shape, result.dtype)
+                result = jnp.where(w, result, base)
 
     use_comm = comm or get_comm()
     if out is not None:
         sanitation.sanitize_out(out, out_shape, out_split, device)
-        result = use_comm.shard(result.astype(out.dtype.jax_type()), out.split)
+        result = use_comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)
         out.larray = result
         return out
     result = use_comm.shard(result, out_split)
@@ -176,7 +203,7 @@ def local_op(
     result = operation(x.larray, **fn_kwargs)
     if out is not None:
         sanitation.sanitize_out(out, x.gshape, x.split, x.device)
-        out.larray = x.comm.shard(result.astype(out.dtype.jax_type()), out.split)
+        out.larray = x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)
         return out
     result = x.comm.shard(result, x.split)
     return DNDarray(
@@ -223,7 +250,7 @@ def reduce_op(
         out_split = None
     if out is not None:
         sanitation.sanitize_out(out, out_shape, out_split, x.device)
-        out.larray = x.comm.shard(result.astype(out.dtype.jax_type()), out.split)
+        out.larray = x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)
         return out
     result = x.comm.shard(result, out_split)
     return DNDarray(
@@ -247,10 +274,10 @@ def cum_op(
         raise NotImplementedError("cumulative operations require an explicit axis")
     result = operation(x.larray, axis=axis, **fn_kwargs)
     if dtype is not None:
-        result = result.astype(types.canonical_heat_type(dtype).jax_type())
+        result = _safe_astype(result, types.canonical_heat_type(dtype).jax_type())
     if out is not None:
         sanitation.sanitize_out(out, x.gshape, x.split, x.device)
-        out.larray = x.comm.shard(result.astype(out.dtype.jax_type()), out.split)
+        out.larray = x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)
         return out
     result = x.comm.shard(result, x.split)
     return DNDarray(

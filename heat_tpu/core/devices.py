@@ -204,3 +204,15 @@ def complex_needs_host(*dtypes_or_values) -> bool:
     if rt is None or not np.issubdtype(rt, np.complexfloating):
         return False
     return not complex_supported()
+
+
+def complex_creation_ctx(*dtypes_or_values):
+    """Context manager that places array creation on host CPU when the promoted
+    dtype of ``dtypes_or_values`` cannot live on the accelerator (see
+    :func:`complex_needs_host`); a nullcontext otherwise. The one helper behind
+    every factory/dispatch complex-fallback site."""
+    from contextlib import nullcontext
+
+    if complex_needs_host(*dtypes_or_values):
+        return jax.default_device(cpu_fallback_device())
+    return nullcontext()

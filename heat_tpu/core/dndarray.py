@@ -365,8 +365,10 @@ class DNDarray:
     # ------------------------------------------------------------------ conversion
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
         """Cast to a new datatype (reference ``dndarray.py:222``)."""
+        from ._operations import _safe_astype
+
         dtype = types.canonical_heat_type(dtype)
-        casted = self.__array.astype(dtype.jax_type())
+        casted = _safe_astype(self.__array, dtype.jax_type())
         casted = self.__comm.shard(casted, self.__split)
         if copy:
             return DNDarray(casted, self.__gshape, dtype, self.__split, self.__device, self.__comm, self.__balanced)

@@ -47,11 +47,11 @@ def _complex_to_host(value, target_dtype=None):
     the process — see devices.accelerator_capabilities), values that are or are
     about to become complex move to the host CPU. All factory paths converge here
     through ``_wrap``."""
+    from ._operations import _on_accelerator
     from .devices import complex_needs_host, cpu_fallback_device
 
     if complex_needs_host(target_dtype if target_dtype is not None else value):
-        dev = getattr(value, "device", None)
-        if dev is None or getattr(dev, "platform", "cpu") != "cpu":
+        if not isinstance(value, jax.Array) or _on_accelerator(value):
             return jax.device_put(value, cpu_fallback_device())
     return value
 
@@ -91,6 +91,8 @@ def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
         start, stop, step = args
     else:
         raise TypeError(f"function takes minimum one and at most 3 positional arguments ({num_args} given)")
+    from .devices import complex_creation_ctx
+
     if dtype is None:
         # match the reference: all-int args → int32, otherwise default float
         if all(isinstance(a, (int, np.integer)) for a in (start, stop, step)):
@@ -98,7 +100,9 @@ def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
         else:
             value = jnp.arange(start, stop, step, dtype=jnp.float32)
     else:
-        value = jnp.arange(start, stop, step, dtype=types.canonical_heat_type(dtype).jax_type())
+        jt = types.canonical_heat_type(dtype).jax_type()
+        with complex_creation_ctx(np.dtype(jt)):
+            value = jnp.arange(start, stop, step, dtype=jt)
     return _wrap(value, dtype, split, device, comm)
 
 
@@ -209,15 +213,12 @@ def __factory(shape, dtype, split, maker, device, comm, order="C") -> DNDarray:
     """Shared logic of empty/ones/zeros/full (reference ``factories.py:699``)."""
     shape = sanitize_shape(shape)
     dtype = types.canonical_heat_type(dtype)
-    from .devices import complex_needs_host, cpu_fallback_device
+    from .devices import complex_creation_ctx
 
-    if complex_needs_host(np.dtype(dtype.jax_type())):
-        # create on host outright: even materializing complex on such an
-        # accelerator poisons the process (devices.accelerator_capabilities)
-        with jax.default_device(cpu_fallback_device()):
-            value = maker(shape, dtype=dtype.jax_type())
-        return _wrap(value, dtype, split, device, comm)
-    value = maker(shape, dtype=dtype.jax_type())
+    # complex creation happens on host when the accelerator can't hold it
+    # (devices.accelerator_capabilities); nullcontext otherwise
+    with complex_creation_ctx(np.dtype(dtype.jax_type())):
+        value = maker(shape, dtype=dtype.jax_type())
     return _wrap(value, dtype, split, device, comm)
 
 
@@ -239,9 +240,7 @@ def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="
 
 def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Constant fill (reference ``factories.py:957``)."""
-    from contextlib import nullcontext
-
-    from .devices import complex_needs_host, cpu_fallback_device
+    from .devices import complex_creation_ctx
 
     shape = sanitize_shape(shape)
     target = (
@@ -249,12 +248,7 @@ def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, orde
         if dtype is None
         else np.dtype(types.canonical_heat_type(dtype).jax_type())
     )
-    ctx = (
-        jax.default_device(cpu_fallback_device())
-        if complex_needs_host(target)
-        else nullcontext()
-    )
-    with ctx:
+    with complex_creation_ctx(target):
         if dtype is None:
             value = jnp.full(shape, fill_value)
             if value.dtype == jnp.float64 and isinstance(fill_value, float):
@@ -311,7 +305,10 @@ def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDar
         else:
             n, m = int(shape[0]), int(shape[1])
     dtype = types.canonical_heat_type(dtype)
-    value = jnp.eye(n, m, dtype=dtype.jax_type())
+    from .devices import complex_creation_ctx
+
+    with complex_creation_ctx(np.dtype(dtype.jax_type())):
+        value = jnp.eye(n, m, dtype=dtype.jax_type())
     return _wrap(value, dtype, split, device, comm)
 
 

@@ -1,0 +1,114 @@
+"""Cross-module numpy-parity fuzz: manipulations and linalg ops checked against
+numpy for every split (complements the per-module suites with the long tail of
+argument combinations — offsets, ords, axis moves, tiling reps)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((9, 7)).astype(np.float32)
+X3 = rng.standard_normal((4, 6, 5)).astype(np.float32)
+XI = rng.integers(0, 10, (9, 7))
+SQ = rng.standard_normal((6, 6)).astype(np.float64)
+B2 = rng.standard_normal((7, 5)).astype(np.float32)
+
+
+def _chk(got, want):
+    g = got.numpy() if isinstance(got, ht.DNDarray) else np.asarray(got)
+    assert g.shape == want.shape
+    np.testing.assert_allclose(g, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+class TestManipulationsFuzz:
+    def test_axis_ops(self, split):
+        a = ht.array(X, split=split)
+        for axis in (0, 1):
+            _chk(ht.sort(a, axis=axis)[0], np.sort(X, axis=axis))
+            _chk(ht.flip(a, axis), np.flip(X, axis))
+            _chk(ht.roll(a, 3, axis), np.roll(X, 3, axis))
+
+    def test_shape_ops(self, split):
+        a = ht.array(X, split=split)
+        _chk(ht.pad(a, ((1, 2), (0, 3))), np.pad(X, ((1, 2), (0, 3))))
+        _chk(ht.rot90(a), np.rot90(X))
+        _chk(ht.repeat(a, 3, axis=1), np.repeat(X, 3, axis=1))
+        _chk(ht.tile(a, (2, 3)), np.tile(X, (2, 3)))
+        _chk(ht.reshape(a, (7, 9)), X.reshape(7, 9))
+        _chk(ht.flatten(a), X.flatten())
+        _chk(ht.unique(ht.array(XI, split=split)), np.unique(XI))
+        _chk(
+            ht.moveaxis(ht.array(X3, split=split if split != 1 else 2), 0, 2),
+            np.moveaxis(X3, 0, 2),
+        )
+
+    def test_diagonals_topk(self, split):
+        a = ht.array(X, split=split)
+        _chk(ht.diag(a), np.diag(X))
+        _chk(ht.diagonal(a, offset=1), np.diagonal(X, offset=1))
+        tv, _ = ht.topk(a, 3, dim=1)
+        _chk(tv, -np.sort(-X, axis=1)[:, :3])
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+class TestLinalgFuzz:
+    def test_norms_and_tri(self, split):
+        a = ht.array(X, split=split)
+        _chk(ht.linalg.norm(a), np.asarray(np.linalg.norm(X)))
+        _chk(ht.linalg.vector_norm(a, axis=0), np.linalg.norm(X, axis=0))
+        _chk(ht.linalg.matrix_norm(a, ord=1), np.asarray(np.linalg.norm(X, 1)))
+        _chk(ht.trace(a), np.asarray(np.trace(X)))
+        _chk(ht.tril(a), np.tril(X))
+        _chk(ht.triu(a, 1), np.triu(X, 1))
+
+    def test_solve_and_products(self, split):
+        sqh = ht.array(SQ, split=split)
+        _chk(ht.linalg.det(sqh), np.asarray(np.linalg.det(SQ)))
+        _chk(ht.linalg.inv(sqh), np.linalg.inv(SQ))
+        a = ht.array(X, split=split)
+        _chk(ht.matmul(a, ht.array(B2, split=split)), X @ B2)
+        _chk(ht.vdot(ht.array(X[0]), ht.array(X[1])), np.asarray(np.vdot(X[0], X[1])))
+        _chk(
+            ht.cross(ht.array(X[:, :3], split=split), ht.array(X[:, 3:6], split=split)),
+            np.cross(X[:, :3], X[:, 3:6]),
+        )
+
+
+class TestStatisticsFuzz:
+    def test_moments_and_quantiles(self):
+        scipy_stats = pytest.importorskip("scipy.stats")
+        x = rng.standard_normal((30, 5)).astype(np.float64)
+        xh = ht.array(x, split=0)
+        # reference semantics: unbiased estimators by default (scipy bias=False)
+        _chk(ht.kurtosis(xh, axis=0), scipy_stats.kurtosis(x, axis=0, bias=False))
+        _chk(ht.skew(xh, axis=0), scipy_stats.skew(x, axis=0, bias=False))
+        _chk(ht.median(xh, axis=1), np.median(x, axis=1))
+        _chk(
+            ht.average(xh, axis=0, weights=ht.array(np.arange(1.0, 31.0))),
+            np.average(x, axis=0, weights=np.arange(1.0, 31.0)),
+        )
+        _chk(ht.cov(ht.array(x.T)), np.cov(x.T))
+        _chk(ht.cov(ht.array(x.T), ddof=0), np.cov(x.T, ddof=0))
+
+    def test_histogram_digitize(self):
+        x = rng.standard_normal(150).astype(np.float64)
+        h, e = np.histogram(x, bins=7)
+        hh, he = ht.histogram(ht.array(x, split=0), bins=7)
+        _chk(hh, h)
+        _chk(he, e)
+        edges = np.linspace(-2, 2, 5)
+        _chk(ht.digitize(ht.array(x, split=0), ht.array(edges)), np.digitize(x, edges))
+
+
+class TestSparseScipyFuzz:
+    def test_union_ops_match_scipy(self):
+        sp = pytest.importorskip("scipy.sparse")
+        d1 = sp.random(8, 10, density=0.3, random_state=0, format="csr", dtype=np.float32)
+        d2 = sp.random(8, 10, density=0.3, random_state=1, format="csr", dtype=np.float32)
+        h1 = ht.sparse.sparse_csr_matrix(ht.array(d1.toarray(), split=0))
+        h2 = ht.sparse.sparse_csr_matrix(ht.array(d2.toarray(), split=0))
+        _chk(ht.sparse.to_dense(h1), d1.toarray())
+        _chk(ht.sparse.to_dense(ht.sparse.add(h1, h2)), (d1 + d2).toarray())
+        _chk(ht.sparse.to_dense(ht.sparse.mul(h1, h2)), d1.multiply(d2).toarray())
