@@ -1,0 +1,206 @@
+"""Behavioral tests mirroring the reference's heavier suites: distribution verbs,
+RNG state machinery, data tools determinism, DCSR surface, and error paths
+(reference test_dndarray.py / test_random.py / test_communication.py patterns)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+
+
+class TestDistributionVerbs:
+    def test_resplit_roundtrip_all_pairs(self):
+        x = np.arange(60.0, dtype=np.float32).reshape(10, 6)
+        for frm in (None, 0, 1):
+            for to in (None, 0, 1):
+                a = ht.array(x, split=frm)
+                a.resplit_(to)
+                assert a.split == to
+                np.testing.assert_allclose(a.numpy(), x)
+
+    def test_balance_is_idempotent(self):
+        a = ht.arange(23, split=0)
+        assert a.is_balanced()
+        a.balance_()
+        assert a.is_balanced()
+        np.testing.assert_allclose(a.numpy(), np.arange(23))
+
+    def test_redistribute_noop_keeps_values(self):
+        x = np.arange(24.0, dtype=np.float32).reshape(8, 3)
+        a = ht.array(x, split=0)
+        a.redistribute_()
+        np.testing.assert_allclose(a.numpy(), x)
+        assert a.split == 0
+
+    def test_collect_gathers_to_none_split_semantics(self):
+        a = ht.arange(17, split=0)
+        a.collect_()
+        np.testing.assert_allclose(a.numpy(), np.arange(17))
+
+    def test_lshape_map_sums_to_gshape(self):
+        n = ht.get_comm().size
+        a = ht.arange(3 * n + 1, split=0)  # deliberately ragged
+        m = np.asarray(a.lshape_map())
+        assert m.sum() == 3 * n + 1
+
+    def test_partitioned_protocol_roundtrip(self):
+        x = np.arange(40.0, dtype=np.float32).reshape(8, 5)
+        a = ht.array(x, split=0)
+        meta = a.__partitioned__
+        assert meta["shape"] == (8, 5)
+        b = ht.from_partitioned(a)
+        np.testing.assert_allclose(b.numpy(), x)
+        assert b.split == a.split
+
+    def test_halo_edges(self):
+        n = ht.get_comm().size
+        a = ht.arange(4 * n, split=0)
+        a.get_halo(1)
+        # interior semantics are covered by convolve; here: no crash on the
+        # boundary shards and idempotent re-request
+        a.get_halo(1)
+        b = ht.arange(5, split=0)  # fewer elements than devices on wide meshes
+        b.get_halo(1)
+
+
+class TestRandomState:
+    def test_state_roundtrip_reproduces(self):
+        ht.random.seed(1234)
+        st = ht.random.get_state()
+        x1 = ht.random.rand(16, split=0).numpy()
+        ht.random.set_state(st)
+        x2 = ht.random.rand(16, split=0).numpy()
+        np.testing.assert_allclose(x1, x2)
+
+    def test_seed_changes_stream(self):
+        ht.random.seed(1)
+        a = ht.random.rand(32).numpy()
+        ht.random.seed(2)
+        b = ht.random.rand(32).numpy()
+        assert not np.allclose(a, b)
+
+    def test_randperm_is_permutation(self):
+        ht.random.seed(0)
+        p = ht.random.randperm(50, split=0).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(50))
+
+    def test_permutation_of_array_preserves_multiset(self):
+        ht.random.seed(3)
+        x = np.arange(30)
+        p = ht.random.permutation(ht.array(x, split=0)).numpy()
+        np.testing.assert_array_equal(np.sort(p), x)
+
+    def test_randint_bounds_and_dtype(self):
+        ht.random.seed(7)
+        r = ht.random.randint(5, 11, (200,), split=0)
+        rn = r.numpy()
+        assert rn.min() >= 5 and rn.max() < 11
+
+    def test_randn_split_independence(self):
+        """The counter-based design gives the same stream at any split."""
+        ht.random.seed(42)
+        a = ht.random.randn(24, split=0).numpy()
+        ht.random.seed(42)
+        b = ht.random.randn(24, split=None).numpy()
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+class TestErrorPaths:
+    def test_bitwise_on_floats_raises(self):
+        with pytest.raises(TypeError):
+            ht.bitwise_and(ht.ones(4), ht.ones(4))
+
+    def test_invalid_axis(self):
+        with pytest.raises(ValueError):
+            ht.sum(ht.ones((2, 2)), axis=5)
+
+    def test_split_out_of_range(self):
+        with pytest.raises(ValueError):
+            ht.ones((4,), split=3)
+
+    def test_split_and_is_split_conflict(self):
+        with pytest.raises(ValueError):
+            ht.array([1, 2], split=0, is_split=0)
+
+    def test_item_on_nonscalar(self):
+        with pytest.raises(ValueError):
+            ht.ones((3,)).item()
+
+    def test_matmul_shape_mismatch(self):
+        with pytest.raises((ValueError, TypeError)):
+            ht.matmul(ht.ones((3, 4)), ht.ones((5, 6)))
+
+    def test_concatenate_bad_dims(self):
+        with pytest.raises((ValueError, TypeError)):
+            ht.concatenate([ht.ones((2, 3)), ht.ones((2, 4))], axis=0)
+
+    def test_reshape_bad_size(self):
+        with pytest.raises((ValueError, TypeError)):
+            ht.reshape(ht.ones((4,)), (3,))
+
+
+class TestDataToolsDeterminism:
+    @staticmethod
+    def _flat(batch):
+        v = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return (v.numpy() if isinstance(v, ht.DNDarray) else np.asarray(v)).ravel()
+
+    def test_dataloader_epoch_shuffle_differs_but_covers(self):
+        """Reference semantics: epoch 1 in order, later epochs globally reshuffled
+        (datatools.py:105-140)."""
+        from heat_tpu.utils.data import DataLoader
+
+        x = ht.arange(40, split=0).astype(ht.float32).reshape((40, 1))
+        dl = DataLoader(x, batch_size=8)
+        e1 = np.concatenate([self._flat(b) for b in dl])
+        e2 = np.concatenate([self._flat(b) for b in dl])
+        np.testing.assert_array_equal(np.sort(e1), np.arange(40.0))
+        np.testing.assert_array_equal(np.sort(e2), np.arange(40.0))
+        assert not np.array_equal(e1, e2)
+
+    def test_dataloader_keeps_tail_batch(self):
+        from heat_tpu.utils.data import DataLoader
+
+        x = ht.arange(10, split=0).astype(ht.float32).reshape((10, 1))
+        dl = DataLoader(x, batch_size=4)
+        sizes = [self._flat(b).shape[0] for b in dl]
+        assert sizes == [4, 4, 2]  # drop_last=False parity (torch default)
+
+
+class TestDCSRSurface:
+    def test_methods_and_metadata(self):
+        dense = np.array(
+            [[1.0, 0, 0, 2.0], [0, 0, 3.0, 0], [0, 4.0, 0, 0], [5.0, 0, 0, 6.0]],
+            np.float32,
+        )
+        m = ht.sparse.sparse_csr_matrix(ht.array(dense, split=0))
+        assert m.shape == (4, 4)
+        assert int(m.nnz) == 6
+        np.testing.assert_allclose(ht.sparse.to_dense(m).numpy(), dense)
+        # elementwise scalar ops keep the pattern
+        m2 = ht.sparse.mul(m, m)
+        np.testing.assert_allclose(ht.sparse.to_dense(m2).numpy(), dense * dense)
+
+
+class TestPrinting:
+    def test_str_contains_values_and_meta(self):
+        a = ht.arange(6, split=0)
+        s = str(a)
+        assert "DNDarray" in s
+        assert "5" in s  # the last value is rendered, not just metadata
+
+    def test_print0_and_local(self, capsys):
+        ht.print0("hello-from-rank0")
+        out = capsys.readouterr().out
+        assert "hello-from-rank0" in out
+
+    def test_printoptions_roundtrip(self):
+        ht.set_printoptions(precision=3)
+        try:
+            s = str(ht.array([1.23456789]))
+            assert "1.235" in s or "1.234" in s
+        finally:
+            ht.set_printoptions(precision=4)
