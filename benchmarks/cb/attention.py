@@ -1,0 +1,52 @@
+"""Attention benchmarks (no reference counterpart — the reference has no attention;
+these track the long-context machinery: flash kernel forward, fwd+bwd, and the
+torch-parity MultiheadAttention module)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from benchmarks.cb.monitor import monitor
+from heat_tpu.nn.attention import scaled_dot_product_attention as sdpa
+
+B = int(os.environ.get("HEAT_TPU_BENCH_ATTN_B", "4"))
+H = int(os.environ.get("HEAT_TPU_BENCH_ATTN_H", "8"))
+T = int(os.environ.get("HEAT_TPU_BENCH_ATTN_T", "2048"))
+D = int(os.environ.get("HEAT_TPU_BENCH_ATTN_D", "64"))
+
+
+def _qkv():
+    dt = jnp.bfloat16 if jax.default_backend() != "cpu" else jnp.float32
+    ks = jax.random.split(jax.random.key(11), 3)
+    return tuple(jax.random.normal(k, (B, H, T, D), dt) for k in ks)
+
+
+@monitor("attention_causal_fwd")
+def attention_fwd():
+    q, k, v = _qkv()
+    return sdpa(q, k, v, is_causal=True)
+
+
+@monitor("attention_causal_fwd_bwd")
+def attention_fwd_bwd():
+    q, k, v = _qkv()
+    grads = jax.grad(
+        lambda a, b, c: jnp.sum(sdpa(a, b, c, is_causal=True).astype(jnp.float32)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    return grads  # the monitor blocks on the whole pytree: all of dq/dk/dv are timed
+
+
+@monitor("multihead_attention_module")
+def mha_module():
+    embed = H * D
+    mha = ht.nn.MultiheadAttention(embed, H)
+    mha.reset_parameters(seed=0)
+    x = jax.random.normal(jax.random.key(12), (B, min(T, 512), embed), jnp.float32)
+    out, _ = mha(x, is_causal=True)
+    return out
