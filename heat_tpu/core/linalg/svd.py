@@ -16,6 +16,7 @@ when an approximation at lower cost is acceptable.
 from __future__ import annotations
 
 import collections
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -24,7 +25,7 @@ from .. import types
 from ..dndarray import DNDarray
 from .svdtools import guarded_svd
 
-__all__ = ["svd"]
+__all__ = ["svd", "pinv", "matrix_rank", "cond"]
 
 SVD_t = collections.namedtuple("SVD", "U, S, Vh")
 
@@ -85,3 +86,47 @@ def svd(A: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
     return SVD_t(
         _wrap(A, u_val, u_split), _wrap(A, s_val, None), _wrap(A, vh_val, None)
     )
+
+
+def pinv(A: DNDarray, rtol: float = 1e-15) -> DNDarray:
+    """Moore–Penrose pseudo-inverse via the reduced SVD (numpy.linalg.pinv
+    semantics; not in the reference, which has no full SVD to build it on).
+
+    Singular values below ``rtol * max(s)`` are treated as zero. The result of a
+    split-0 tall input is split along its columns (the transpose of U's rows).
+    """
+    u, s, vh = svd(A)
+    sv = s.larray
+    cutoff = rtol * jnp.max(sv)
+    inv_s = jnp.where(sv > cutoff, 1.0 / jnp.where(sv > cutoff, sv, 1.0), 0.0)
+    # A⁺ = V Σ⁺ Uᴴ — one einsum so XLA fuses the diagonal scale into the matmul
+    value = jnp.einsum(
+        "ij,j,kj->ik", jnp.conj(vh.larray).T, inv_s, jnp.conj(u.larray),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    split = 1 if (A.split == 0 and A.gshape[0] >= A.gshape[1]) else (
+        0 if A.split == 1 else None
+    )
+    return _wrap(A, value, split)
+
+
+def matrix_rank(A: DNDarray, tol: Optional[float] = None) -> DNDarray:
+    """Rank from the singular values (numpy.linalg.matrix_rank semantics:
+    default tol = max(s) * max(m, n) * eps)."""
+    s = svd(A, compute_uv=False)
+    sv = s.larray
+    if tol is None:
+        eps = jnp.finfo(sv.dtype).eps
+        tol_val = jnp.max(sv) * max(A.gshape) * eps
+    else:
+        tol_val = tol
+    value = jnp.sum(sv > tol_val).astype(jnp.int64)
+    return _wrap(A, value, None)
+
+
+def cond(A: DNDarray) -> DNDarray:
+    """2-norm condition number σ_max / σ_min (numpy.linalg.cond(p=2))."""
+    s = svd(A, compute_uv=False)
+    sv = s.larray
+    value = jnp.max(sv) / jnp.min(sv)
+    return _wrap(A, value, None)

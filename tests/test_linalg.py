@@ -252,7 +252,36 @@ class TestTiling(TestCase):
         np.testing.assert_array_equal(np.concatenate(rows, axis=0), a.numpy())
 
 
+
+
+
+class TestSVDDerived(TestCase):
+    def test_pinv_properties(self):
+        rng = np.random.default_rng(20)
+        for shape, split in [((24, 6), 0), ((6, 24), 1), ((8, 8), None)]:
+            an = rng.standard_normal(shape).astype(np.float32)
+            p = ht.linalg.pinv(ht.array(an, split=split))
+            want = np.linalg.pinv(an)
+            np.testing.assert_allclose(p.numpy(), want, rtol=1e-3, atol=1e-3)
+            # Moore-Penrose identity A A+ A = A
+            np.testing.assert_allclose(an @ p.numpy() @ an, an, rtol=1e-3, atol=1e-3)
+
+    def test_matrix_rank(self):
+        rng = np.random.default_rng(21)
+        u = rng.standard_normal((20, 3)).astype(np.float32)
+        v = rng.standard_normal((3, 10)).astype(np.float32)
+        low = u @ v  # rank 3
+        self.assertEqual(int(ht.linalg.matrix_rank(ht.array(low, split=0)).item()), 3)
+        full = rng.standard_normal((12, 7)).astype(np.float32)
+        self.assertEqual(int(ht.linalg.matrix_rank(ht.array(full, split=0)).item()), 7)
+
+    def test_cond(self):
+        rng = np.random.default_rng(22)
+        a = rng.standard_normal((16, 5)).astype(np.float32)
+        got = float(ht.linalg.cond(ht.array(a, split=0)).item())
+        want = float(np.linalg.cond(a))
+        self.assertLess(abs(got - want) / want, 1e-3)
+
 if __name__ == "__main__":
     import unittest
-
     unittest.main()
