@@ -357,7 +357,8 @@ _ragged_cache: dict = {}
 
 
 def _ragged_reshard(array: jax.Array, target: NamedSharding) -> jax.Array:
-    key = (target.mesh.shape_tuple, tuple(target.spec), array.ndim)
+    key = (target, array.ndim)  # NamedSharding hashes mesh + devices, so two
+    # same-shape meshes over different device sets cannot collide
     fn = _ragged_cache.get(key)
     if fn is None:
         fn = jax.jit(lambda x: jax.lax.with_sharding_constraint(x, target))

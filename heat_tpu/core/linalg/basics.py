@@ -49,6 +49,25 @@ __all__ = [
 PARITY_PRECISION = jax.lax.Precision.HIGHEST
 
 
+def _contraction_precision(precision, *operands) -> Optional[jax.lax.Precision]:
+    """Dtype-aware default precision for user-facing MXU contractions.
+
+    An explicit ``precision`` always wins. Otherwise float32 operands get the
+    full-f32 multi-pass MXU schedule so ``ht.matmul(f32, f32)`` matches numpy/torch
+    to ~1e-7 like the reference (torch matmul is exact f32, ``basics.py:422``) —
+    the MXU's native single-pass default would silently round inputs to bf16
+    (~1e-2 error on unit-scale data). bf16/f16 inputs keep the fast native path;
+    f64 is exact under any setting.
+    """
+    if precision is not None:
+        return precision
+    for o in operands:
+        value = o.larray if isinstance(o, DNDarray) else o
+        if getattr(value, "dtype", None) == jnp.float32:
+            return jax.lax.Precision.HIGHEST
+    return None
+
+
 def _wrap_like(value: jax.Array, proto: DNDarray, split: Optional[int]) -> DNDarray:
     if split is not None and (split >= value.ndim or split < 0):
         split = None
@@ -68,11 +87,13 @@ def matmul(
     batch-dim splits are preserved. The data movement itself is XLA SPMD's choice
     (typically all-gather of the smaller panel riding ICI).
 
-    ``precision`` passes through to ``jnp.matmul`` — ``None`` uses the MXU-native
-    default; pass :data:`PARITY_PRECISION` for the reference's full-fp32 behavior.
+    ``precision`` passes through to ``jnp.matmul`` — ``None`` picks a dtype-aware
+    default (:func:`_contraction_precision`): full-f32 passes for float32 operands,
+    the MXU-native fast path for bf16/f16.
     """
     sanitation.sanitize_in(a)
     sanitation.sanitize_in(b)
+    precision = _contraction_precision(precision, a, b)
     result = jnp.matmul(a.larray, b.larray, precision=precision)
     nd_out = result.ndim
     # position of a's row dim / b's col dim in the output (absent for 1-D operands)
@@ -101,7 +122,7 @@ def dot(
 
         return arithmetics.mul(a, b)
     if a.ndim == 1 and b.ndim == 1:
-        result = jnp.dot(a.larray, b.larray, precision=precision)
+        result = jnp.dot(a.larray, b.larray, precision=_contraction_precision(precision, a, b))
         res = _wrap_like(result, a, None)
         if out is not None:
             out.larray = res.larray
@@ -126,7 +147,7 @@ def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdims: boo
 
 def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
     """Conjugate dot of flattened inputs (reference ``basics.py`` vdot)."""
-    result = jnp.vdot(x1.larray, x2.larray)
+    result = jnp.vdot(x1.larray, x2.larray, precision=_contraction_precision(None, x1, x2))
     return _wrap_like(result, x1, None)
 
 

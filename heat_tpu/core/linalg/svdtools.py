@@ -131,18 +131,22 @@ def hsvd(
     # per-level absolute tolerance (reference: rtol * ||A|| / sqrt(2*nblocks-1))
     loc_atol = None if rtol is None else rtol * Anorm / np.sqrt(2 * nblocks - 1)
 
-    # level 0: truncated SVD of each rank's column block (whole array if replicated).
+    # level 0: truncated SVD of each shard's column block (whole array if replicated).
     # All blocks of a level go through ONE batched SVD (zero-padded to a common
     # width — zero columns add exact-zero singular values, removed by truncation)
     # and ONE host readback of the singular values for the truncation decisions;
     # the reference runs P sequential device round-trips here (svdtools.py:341).
-    if nblocks == 1:
-        nodes: List[jax.Array] = [x]
-    else:
-        bounds = [work.comm.chunk((m, n), 1, rank=r)[2][1] for r in range(nblocks)]
-        nodes = [x[:, sl] for sl in bounds]
+    # The stack is built by a sharding-preserving reshape (device i already holds
+    # exactly column block i under the canonical ceil-division chunking), so each
+    # device only ever materialises its own (m, n/P) block — matching the strictly
+    # local property of the reference's per-rank SVD (svdtools.py:478) — and the
+    # batched SVD runs embarrassingly parallel over the mesh.
     level = 0
-    outs = _batched_truncated_svd(level, nodes, maxrank, loc_atol, safetyshift, silent)
+    if nblocks == 1:
+        outs = _batched_truncated_svd(level, [x], maxrank, loc_atol, safetyshift, silent)
+    else:
+        stacked = _stack_column_blocks(x, nblocks, work.comm)
+        outs = _truncate_stacked(level, stacked, maxrank, loc_atol, safetyshift, silent)
     nodes = [u * s for u, s, _ in outs]  # carry U·diag(sigma) into the merges
     err_squared = [e for _, _, e in outs]
     sigmas = [s for _, s, _ in outs]
@@ -214,6 +218,41 @@ def hsvd(
     return U, rel_error_estimate
 
 
+# jit cache for the level-0 block stacker, keyed by mesh/shape/dtype (compiles once
+# per hsvd configuration; on the real chip a fresh trace costs tens of seconds).
+_stack_cache: dict = {}
+
+
+def _stack_column_blocks(x: jax.Array, nblocks: int, comm) -> jax.Array:
+    """Restack the column-sharded ``(m, n)`` array as ``(nblocks, m, w)`` column
+    blocks, block ``i`` = ``x[:, i*w:(i+1)*w]`` with ``w = ceil(n / nblocks)`` (the
+    canonical ceil-division chunk, :meth:`MeshCommunication.chunk`), zero-padding the
+    last block.
+
+    The leading block axis carries the mesh axis (``P('d', None, None)``): device ``i``
+    already owns exactly column block ``i`` of a split-1 array, so the pad + reshape +
+    transpose is pure local relabeling — the compiled program contains no collectives
+    (verified: no all-to-all/all-gather/collective-permute in the HLO) and each device
+    holds only its own ``m × w`` block, unlike a ``jnp.stack`` of global slices which
+    replicates every block everywhere."""
+    m, n = x.shape
+    w = -(-n // nblocks)
+    pad = w * nblocks - n
+    target = comm.sharding(3, 0)
+    key = (target, nblocks, m, n, str(x.dtype))  # NamedSharding hashes mesh + devices
+    fn = _stack_cache.get(key)
+    if fn is None:
+
+        def f(v):
+            vp = jnp.pad(v, ((0, 0), (0, pad)))
+            st = vp.reshape(m, nblocks, w).transpose(1, 0, 2)
+            return jax.lax.with_sharding_constraint(st, target)
+
+        fn = jax.jit(f)
+        _stack_cache[key] = fn
+    return fn(x)
+
+
 def _batched_truncated_svd(
     level: int,
     blocks: List[jax.Array],
@@ -222,12 +261,11 @@ def _batched_truncated_svd(
     safetyshift: int,
     silent: bool = True,
 ) -> List[Tuple[jax.Array, jax.Array, float]]:
-    """Truncated SVDs of one whole tree level (reference runs
-    ``compute_local_truncated_svd`` ``svdtools.py:478`` per node, each with its own
-    host sync): blocks are zero-padded to a common width, factored by ONE batched
-    ``jnp.linalg.svd``, and the singular values cross to host in ONE transfer for the
-    noise-floor / rank / atol truncation decisions. Per node, returns
-    ``(U_trunc, sigma_trunc, err²_dropped)``."""
+    """Truncated SVDs of a list of node blocks: zero-pad to a common width, stack,
+    and delegate to :func:`_truncate_stacked`. Used for the merge levels (node widths
+    are small, ≤ ``maxrank + safetyshift`` columns each) and the final root
+    truncation; level 0 builds its stack sharding-preservingly via
+    :func:`_stack_column_blocks` instead."""
     wmax = max(b.shape[1] for b in blocks)
     stacked = jnp.stack(
         [
@@ -235,20 +273,37 @@ def _batched_truncated_svd(
             for b in blocks
         ]
     )
+    return _truncate_stacked(level, stacked, maxrank, loc_atol, safetyshift, silent)
+
+
+def _truncate_stacked(
+    level: int,
+    stacked: jax.Array,
+    maxrank: int,
+    loc_atol: Optional[float],
+    safetyshift: int,
+    silent: bool = True,
+) -> List[Tuple[jax.Array, jax.Array, float]]:
+    """Truncated SVDs of one whole tree level from a pre-stacked ``(B, m, w)`` operand
+    (reference runs ``compute_local_truncated_svd`` ``svdtools.py:478`` per node, each
+    with its own host sync): ONE batched ``jnp.linalg.svd`` — shard-local when the
+    stack's block axis is sharded — and the singular values cross to host in ONE
+    transfer for the noise-floor / rank / atol truncation decisions. Per node, returns
+    ``(U_trunc, sigma_trunc, err²_dropped)``."""
     u, s, _ = guarded_svd(stacked)
     noiselevel = 1e-14 if stacked.dtype == jnp.float64 else 1e-7
     s_all = np.asarray(s)  # the level's single host sync
 
     results: List[Tuple[jax.Array, jax.Array, float]] = []
-    for node_id, blk in enumerate(blocks):
+    for node_id in range(stacked.shape[0]):
         s_np = s_all[node_id]
         above = np.nonzero(s_np >= noiselevel)[0]
         if len(above) == 0:
             err = float(np.linalg.norm(s_np) ** 2)
             results.append(
                 (
-                    jnp.zeros((blk.shape[0], 1), blk.dtype),
-                    jnp.zeros((1,), blk.dtype),
+                    jnp.zeros((stacked.shape[1], 1), stacked.dtype),
+                    jnp.zeros((1,), stacked.dtype),
                     err,
                 )
             )
