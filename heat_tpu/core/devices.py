@@ -113,7 +113,11 @@ def accelerator_capabilities() -> dict:
     Some TPU runtimes cannot hold complex values or lower FFT HLOs at all — and a
     failed attempt POISONS the issuing process's backend (observed: after one
     UNIMPLEMENTED complex/fft op, every later op including plain f32 reductions
-    fails). The probe therefore runs in a subprocess, once, and is cached.
+    fails). The probe therefore runs in a subprocess, once per *machine* rather than
+    once per process: the outcome is persisted to a cache file keyed by platform /
+    device kind / jax version (``HEAT_TPU_CAPS_CACHE`` overrides the path), so fresh
+    processes don't re-pay the probe — on exclusively-held accelerators the child
+    cannot initialize and each un-cached probe would stall until its timeout.
     Overrides: HEAT_TPU_COMPLEX_BACKEND=cpu|device, HEAT_TPU_FFT_BACKEND=cpu|device.
     """
     global _ACCEL_CAPS
@@ -133,42 +137,124 @@ def accelerator_capabilities() -> dict:
             caps.setdefault("complex", True)
             caps.setdefault("fft", True)
         else:
-            import subprocess
-            import sys
-
-            # the child must land on the SAME accelerator platform as the parent —
-            # on exclusively-locked devices it may fail to initialize (or silently
-            # fall back to CPU, which would report false support); both cases are
-            # treated as "unsupported", which is slow-but-safe (host execution)
-            # rather than process-poisoning
-            parent_platform = jax.devices()[0].platform
-            code = (
-                "import jax, jax.numpy as jnp, numpy as np\n"
-                f"assert jax.devices()[0].platform == {parent_platform!r}\n"
-                "ok_c = ok_f = False\n"
-                "try:\n"
-                "    np.asarray(jnp.array(np.ones(4, np.complex64)) + 1j); ok_c = True\n"
-                "except Exception: pass\n"
-                "try:\n"
-                "    np.asarray(jnp.fft.fft(jnp.ones(4, jnp.complex64))); ok_f = True\n"
-                "except Exception: pass\n"
-                "print('CAPS', int(ok_c), int(ok_f))\n"
-            )
-            try:
-                proc = subprocess.run(
-                    [sys.executable, "-c", code], capture_output=True, timeout=180, text=True
-                )
-                line = next(
-                    (l for l in proc.stdout.splitlines() if l.startswith("CAPS")), "CAPS 0 0"
-                )
-                _, c, f = line.split()
-                caps.setdefault("complex", bool(int(c)))
-                caps.setdefault("fft", bool(int(f)))
-            except Exception:
-                caps.setdefault("complex", False)
-                caps.setdefault("fft", False)
+            cached = _read_caps_cache()
+            if cached is not None:
+                caps.setdefault("complex", cached["complex"])
+                caps.setdefault("fft", cached["fft"])
+            else:
+                probed, probe_ok = _probe_caps_subprocess()
+                caps.setdefault("complex", probed["complex"])
+                caps.setdefault("fft", probed["fft"])
+                _write_caps_cache(probed, probe_ok)
     _ACCEL_CAPS = caps
     return caps
+
+
+def _caps_cache_path() -> str:
+    import os
+    import tempfile
+
+    override = os.environ.get("HEAT_TPU_CAPS_CACHE")
+    if override:
+        return override
+    try:
+        kind = jax.devices()[0].device_kind.replace(" ", "_").replace("/", "_")
+    except Exception:
+        kind = "unknown"
+    try:
+        import jaxlib
+
+        runtime = jaxlib.__version__  # capability limits live in the runtime build,
+        # not the jax front-end — key on it so runtime up/downgrades re-probe
+    except Exception:
+        runtime = "unknown"
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    name = (
+        f"heat_tpu_caps_u{uid}_{jax.default_backend()}_{kind}"
+        f"_jax{jax.__version__}_rt{runtime}.json"
+    )
+    return os.path.join(tempfile.gettempdir(), name)
+
+
+# How long a FAILED probe (child could not run at all — e.g. the accelerator was
+# exclusively held) stays cached. A clean probe that *ran* and reported
+# unsupported ops is a stable hardware fact and is cached indefinitely; a probe
+# that couldn't run must not permanently condemn a capable chip.
+_FAILED_PROBE_TTL_S = 900.0
+
+
+def _read_caps_cache() -> Optional[dict]:
+    import json
+    import os
+    import time
+
+    try:
+        path = _caps_cache_path()
+        if hasattr(os, "getuid") and os.stat(path).st_uid != os.getuid():
+            return None  # never trust a cache file another user planted
+        with open(path) as fh:
+            data = json.load(fh)
+        if not data.get("probe_ok", True):
+            if time.time() - float(data.get("time", 0)) > _FAILED_PROBE_TTL_S:
+                return None
+        return {"complex": bool(data["complex"]), "fft": bool(data["fft"])}
+    except Exception:
+        return None
+
+
+def _write_caps_cache(caps: dict, probe_ok: bool) -> None:
+    import json
+    import os
+    import time
+
+    try:
+        path = _caps_cache_path()
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as fh:
+            json.dump({**caps, "probe_ok": probe_ok, "time": time.time()}, fh)
+    except Exception:
+        pass  # cache is best-effort; the in-process memo still holds
+
+
+def _probe_caps_subprocess() -> tuple:
+    """Returns ``(caps, probe_ok)``: ``probe_ok`` is True when the child actually ran
+    the probe (its verdict — positive or negative — is a stable hardware fact) and
+    False when the child itself failed (timeout, init failure), i.e. the conservative
+    all-False answer is a guess."""
+    import subprocess
+    import sys
+
+    # the child must land on the SAME accelerator platform as the parent —
+    # on exclusively-locked devices it may fail to initialize (or silently
+    # fall back to CPU, which would report false support); both cases are
+    # treated as "unsupported", which is slow-but-safe (host execution)
+    # rather than process-poisoning
+    parent_platform = jax.devices()[0].platform
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np\n"
+        f"assert jax.devices()[0].platform == {parent_platform!r}\n"
+        "ok_c = ok_f = False\n"
+        "try:\n"
+        "    np.asarray(jnp.array(np.ones(4, np.complex64)) + 1j); ok_c = True\n"
+        "except Exception: pass\n"
+        "try:\n"
+        "    np.asarray(jnp.fft.fft(jnp.ones(4, jnp.complex64))); ok_f = True\n"
+        "except Exception: pass\n"
+        "print('CAPS', int(ok_c), int(ok_f))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=90, text=True
+        )
+        line = next(
+            (l for l in proc.stdout.splitlines() if l.startswith("CAPS")), None
+        )
+        if line is None:
+            return {"complex": False, "fft": False}, False
+        _, c, f = line.split()
+        return {"complex": bool(int(c)), "fft": bool(int(f))}, True
+    except Exception:
+        return {"complex": False, "fft": False}, False
 
 
 def complex_supported() -> bool:

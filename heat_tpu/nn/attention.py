@@ -152,9 +152,7 @@ def ring_attention(q, k, v, axis_name: str, is_causal: bool = False,
     l0 = zero_q
     perm = [(i, (i - 1) % p) for i in range(p)]  # after s steps, device i holds chunk (i+s) % p
 
-    def step(carry, step_idx):
-        k_c, v_c, o, m, l = carry
-        src = (my + step_idx) % p
+    def attend(o, m, l, k_c, v_c, src):
         scores = jnp.einsum(
             "...qd,...kd->...qk", q, k_c, preferred_element_type=jnp.float32
         ) * jnp.float32(s)
@@ -170,14 +168,24 @@ def ring_attention(q, k, v, axis_name: str, is_causal: bool = False,
         o_new = o * corr[..., None] + jnp.einsum(
             "...qk,...kd->...qd", pij, v_c, preferred_element_type=jnp.float32
         )
+        return o_new, m_new, l_new
+
+    def step(carry, step_idx):
+        k_c, v_c, o, m, l = carry
+        o, m, l = attend(o, m, l, k_c, v_c, (my + step_idx) % p)
         k_next = lax.ppermute(k_c, axis_name, perm)
         v_next = lax.ppermute(v_c, axis_name, perm)
-        return (k_next, v_next, o_new, m_new, l_new), None
+        return (k_next, v_next, o, m, l), None
 
-    (k_f, v_f, o, m, l), _ = lax.scan(
-        step, (k, v, o0, m0, l0), jnp.arange(p)
-    )
-    del k_f, v_f
+    # scan only the p-1 steps that are followed by a rotation; the last block is
+    # consumed outside the scan so its k/v are never ppermuted onward (that final
+    # rotation would be dead inter-chip traffic XLA cannot eliminate from the carry)
+    o, m, l = o0, m0, l0
+    if p > 1:
+        (k, v, o, m, l), _ = lax.scan(
+            step, (k, v, o, m, l), jnp.arange(p - 1)
+        )
+    o, m, l = attend(o, m, l, k, v, (my + p - 1) % p)
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
@@ -311,8 +319,10 @@ class MultiheadAttention(Module):
         if proto is not None:
             from ..core._operations import wrap_result
 
-            # output has the query's (B, T, E) shape: batch and sequence splits survive
-            keep = proto.split if proto.split in (0, seq_axis_in) else None
+            # output has the query's (B, T, E) / (T, B, E) shape: batch and sequence
+            # splits both survive in either layout (only the embed axis is mixed by
+            # the projections)
+            keep = proto.split if proto.split in (0, 1) else None
             return wrap_result(o, proto, keep)
         return o
 

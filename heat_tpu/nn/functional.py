@@ -435,7 +435,10 @@ def pad(x, pad_widths, mode: str = "constant", value: float = 0.0):
     cfg = [(0, 0)] * (v.ndim - npairs) + [
         (int(pad_widths[2 * i]), int(pad_widths[2 * i + 1])) for i in range(npairs - 1, -1, -1)
     ]
-    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    modes = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}
+    jmode = modes.get(mode)
+    if jmode is None:
+        raise ValueError(f"unsupported pad mode {mode!r}; expected one of {sorted(modes)}")
     out = (
         jnp.pad(v, cfg, mode="constant", constant_values=value)
         if jmode == "constant"
