@@ -50,6 +50,66 @@ def get_data(n=2048, classes=10, seed=0):
     return x, y.astype(np.int64)
 
 
+def load_imagenet_dir(data_dir, n_max, image_size=32):
+    """Real-data path: an already-merged ``imagenet_merged.h5`` (produced offline by
+    :func:`heat_tpu.utils.data._utils.merge_files_imagenet_tfrecord`, the reference's
+    ``_utils.py:47`` prep step) or a directory of preprocessed-imagenet TFRecord
+    shards, which are stream-decoded shard by shard and stopped after ``n_max``
+    samples — an implicit full merge of a 1.3M-image directory would be hours of prep
+    for a short example run. Returns (x, y) of square-resized samples, or None when
+    the directory holds neither."""
+    import binascii
+
+    from PIL import Image
+
+    from heat_tpu.utils.data import _utils
+
+    def _resize(raw_hw3):
+        img = np.asarray(
+            Image.fromarray(raw_hw3).resize((image_size, image_size)), np.float32
+        )
+        return img.transpose(2, 0, 1) / 255.0
+
+    xs, ys = [], []
+    try:
+        entries = os.listdir(data_dir)
+    except OSError:
+        return None  # unreadable/nonexistent dir → main()'s guidance message
+    merged = os.path.join(data_dir, "imagenet_merged.h5")
+    if os.path.exists(merged):
+        import h5py
+
+        with h5py.File(merged, "r") as fh:
+            images, meta = fh["images"], fh["metadata"]
+            for lo in range(0, min(len(images), n_max), 256):
+                hi = min(lo + 256, len(images), n_max)
+                for img_str, m in zip(images[lo:hi], meta[lo:hi]):
+                    h, w = int(m[0]), int(m[1])
+                    raw = np.frombuffer(
+                        binascii.a2b_base64(img_str), dtype=np.uint8
+                    ).reshape(h, w, 3)
+                    xs.append(_resize(raw))
+                    ys.append(int(m[3]))
+    else:
+        shards = sorted(
+            os.path.join(data_dir, f)
+            for f in entries
+            if f.startswith("train") and os.path.isfile(os.path.join(data_dir, f))
+        )
+        for shard in shards:
+            if len(xs) >= n_max:
+                break
+            for feats in _utils.read_tfrecord_file(shard):
+                if len(xs) >= n_max:
+                    break
+                raw = _utils._decode_jpeg_rgb(feats["image/encoded"].bytes_list[0])
+                xs.append(_resize(raw))
+                ys.append(int(feats["image/class/label"].int64_list[0] - 1))
+    if not xs:
+        return None
+    return np.stack(xs), np.asarray(ys, np.int64)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description="heat_tpu imagenet-DASO example")
     parser.add_argument("--epochs", type=int, default=6)
@@ -57,6 +117,12 @@ def main(argv=None):
     parser.add_argument("--lr", type=float, default=5e-3)
     parser.add_argument("--nodes", type=int, default=0, help="node groups (0 = auto)")
     parser.add_argument("--n", type=int, default=2048)
+    parser.add_argument(
+        "--data-dir",
+        default=None,
+        help="directory of imagenet TFRecord shards or a merged imagenet_merged.h5 "
+        "(synthetic data when omitted)",
+    )
     args = parser.parse_args(argv)
 
     import jax
@@ -65,7 +131,15 @@ def main(argv=None):
     n_nodes = args.nodes or (2 if ndev % 2 == 0 and ndev > 1 else 1)
     comm = ht.MeshCommunication.hierarchical(n_nodes) if n_nodes > 1 else ht.get_comm()
 
-    np_x, np_y = get_data(n=args.n)
+    data = load_imagenet_dir(args.data_dir, args.n) if args.data_dir else None
+    if args.data_dir and data is None:
+        raise SystemExit(
+            f"--data-dir {args.data_dir!r} holds neither imagenet_merged.h5 nor "
+            "train* TFRecord shards; run "
+            "heat_tpu.utils.data._utils.merge_files_imagenet_tfrecord first or omit "
+            "--data-dir for synthetic data"
+        )
+    np_x, np_y = data if data is not None else get_data(n=args.n)
     # the reference's DALI pipeline does flip+normalize on the fly; same augmentation
     augment = T.Compose(
         [T.RandomHorizontalFlip(0.5), T.Normalize([0.0] * 3, [1.0] * 3)]
@@ -80,7 +154,7 @@ def main(argv=None):
     x_train, y_train = x[:n_train], y[:n_train]
     x_test, y_test = x[n_train:], y[n_train:]
 
-    model = ConvNet()
+    model = ConvNet(classes=max(10, int(np_y.max()) + 1))
     local = ht.optim.DataParallelOptimizer("adam", lr=args.lr)
     dp_model = ht.nn.DataParallelMultiGPU(model, optimizer=local, comm=comm)
     daso = ht.optim.DASO(

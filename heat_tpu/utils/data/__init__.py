@@ -1,4 +1,9 @@
-"""Data utilities (reference heat/utils/data/)."""
+"""Data utilities (reference heat/utils/data/__init__.py: datatools + partial_dataset
+re-exported flat, matrixgallery/mnist/spherical as submodules; MNISTDataset and the
+matrixgallery generators are additionally importable directly for convenience)."""
 
 from .datatools import *
-from . import datatools, matrixgallery, mnist, partial_dataset, spherical
+from .mnist import MNISTDataset
+from .partial_dataset import *
+from . import _utils, datatools, matrixgallery, mnist, partial_dataset, spherical
+from .matrixgallery import hermitian, parter, random_known_rank, random_known_singularvalues

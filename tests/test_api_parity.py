@@ -1,0 +1,106 @@
+"""Full API-surface parity sweep: every name in every reference module ``__all__``
+must resolve in the corresponding heat_tpu namespace (SURVEY.md §2.3; the reference
+namespace is flat ``ht.*`` re-exporting all of core, reference heat/__init__.py:1-21).
+
+The reference tree is parsed with ``ast`` — never imported (it needs mpi4py/torch MPI
+machinery) and never executed. Skipped when /root/reference is absent (e.g. when the
+package is tested standalone).
+"""
+
+import ast
+import os
+
+import pytest
+
+import heat_tpu as ht
+
+REFERENCE = "/root/reference/heat"
+
+# reference package dir (relative to heat/) -> object the names must resolve on
+NAMESPACE_MAP = {
+    ".": ht,
+    "core": ht,
+    "core/linalg": ht.linalg,
+    "fft": ht.fft,
+    "sparse": ht.sparse,
+    "cluster": ht.cluster,
+    "classification": ht.classification,
+    "naive_bayes": ht.naive_bayes,
+    "regression": ht.regression,
+    "preprocessing": ht.preprocessing,
+    "spatial": ht.spatial,
+    "graph": ht.graph,
+    "nn": ht.nn,
+    "optim": ht.optim,
+    "utils": ht.utils,
+    "utils/data": ht.utils.data,
+    "random": ht.random,
+}
+
+
+def _module_all(path):
+    """Names in a module's literal ``__all__`` assignment, else []."""
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read())
+    except SyntaxError:
+        return []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                    try:
+                        return [str(x) for x in ast.literal_eval(node.value)]
+                    except (ValueError, SyntaxError):
+                        return []
+    return []
+
+
+def _collect_reference_names():
+    """{(namespace_key, name): defining_file} over the whole reference tree."""
+    out = {}
+    for rel, ns in NAMESPACE_MAP.items():
+        pkg_dir = os.path.normpath(os.path.join(REFERENCE, rel))
+        if not os.path.isdir(pkg_dir):
+            continue
+        for fname in sorted(os.listdir(pkg_dir)):
+            if not fname.endswith(".py") or fname.startswith("test"):
+                continue
+            if rel == "core" and fname == "random.py":
+                continue  # reference exposes random as the ht.random submodule,
+                # not flat (heat/core/__init__.py:20) — swept separately below
+            for name in _module_all(os.path.join(pkg_dir, fname)):
+                out[(rel, name)] = f"{rel}/{fname}"
+    for name in _module_all(os.path.join(REFERENCE, "core", "random.py")):
+        out[("random",) + (name,)] = "core/random.py"
+    return out
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference tree not present")
+def test_every_reference_name_resolves():
+    names = _collect_reference_names()
+    assert len(names) > 300, f"reference sweep looks broken: only {len(names)} names"
+    missing = []
+    for (rel, name), where in sorted(names.items()):
+        ns = NAMESPACE_MAP[rel]
+        if not hasattr(ns, name):
+            missing.append(f"{where}: {name} (expected on {ns.__name__})")
+    assert not missing, (
+        f"{len(missing)}/{len(names)} reference API names unresolved:\n" + "\n".join(missing)
+    )
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference tree not present")
+def test_data_utils_names_importable_flat():
+    """The four names VERDICT r2 flagged as missing from the utils.data namespace."""
+    from heat_tpu.utils import data
+
+    for name in (
+        "MNISTDataset",
+        "PartialH5Dataset",
+        "PartialH5DataLoaderIter",
+        "matrixgallery",
+        "random_known_rank",
+        "random_known_singularvalues",
+        "hermitian",
+    ):
+        assert hasattr(data, name), name
