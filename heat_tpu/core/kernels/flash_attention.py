@@ -32,12 +32,31 @@ __all__ = ["flash_attention", "flash_attention_reference"]
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
-_BQ = 512
-_BK = 512
-# the backward keeps full q/dO plus three (bq,bk) f32 tiles resident; 256-blocks
-# keep the dk/dv kernel under the 16 MB VMEM ceiling at t=4096
-_BWD_BQ = 256
-_BWD_BK = 256
+# Forward tile-size preference, per input itemsize: the (bq, bk) score/probability
+# tiles are f32 regardless of input dtype (2 × 4·bq·bk bytes resident), so f32
+# inputs take a smaller tile. Measured on v5e at b8·h16·t4096·d64: larger bk
+# amortizes the per-step softmax-state update — (1024, 1024) bf16 is ~1.6× faster
+# than (512, 512). Shapes that only divide 512 fall back to 512-blocks rather than
+# losing the flash path entirely.
+_FWD_BLOCK_PREFS = {
+    2: ((1024, 1024), (512, 1024), (1024, 512), (512, 512)),
+    4: ((512, 1024), (512, 512)),
+}
+_BWD_BQ = 512
+_BWD_BK = 512
+# scalar-prefetch schedule bound: the flattened pair list is O((T/b)²) int32
+# entries shipped to SMEM — cap it well below SMEM capacity
+_MAX_PAIRS = 8192
+
+
+def _fwd_blocks(dtype, tq: int, tk: int) -> tuple:
+    """Largest preferred (bq, bk) that tiles (tq, tk) evenly, else the smallest
+    preference (whose divisibility _fits re-checks and may reject)."""
+    prefs = _FWD_BLOCK_PREFS.get(jnp.dtype(dtype).itemsize, ((512, 512),))
+    for bq, bk in prefs:
+        if tq % bq == 0 and tk % bk == 0:
+            return bq, bk
+    return prefs[-1]
 
 
 def flash_attention_reference(q, k, v, causal: bool = False, scale=None):
@@ -57,63 +76,108 @@ def flash_attention_reference(q, k, v, causal: bool = False, scale=None):
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float, causal: bool,
-            bk: int, compute_dtype=None):
+def _kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+            acc_ref, m_ref, l_ref, *, scale: float, bq: int, bk: int):
+    """One (q-block, k-block) tile of the online-softmax recurrence.
+
+    The grid is the *flattened list of contributing (i, j) pairs* (splash-style):
+    for causal attention the blocks strictly above the diagonal are not idle grid
+    steps — they simply aren't in the list, so the causal kernel really does half
+    the steps. Scalar-prefetched maps give each step its (i, j); flags mark the
+    first/last step of each q-row sweep (init / finalize) and whether the block
+    straddles the diagonal (only those pay the iota/where mask — fully-below
+    blocks skip it).
+
+    Pallas double-buffers the k/v block DMA against compute because the kv pair
+    index advances with the grid. MXU inputs stay in the input dtype (bf16 runs
+    at full MXU rate — forcing f32 here quarters throughput); softmax state and
+    the output accumulator are f32.
+    """
     import jax.experimental.pallas as pl
 
-    iq = pl.program_id(1)
-    bq, d = q_ref.shape[1], q_ref.shape[2]
-    tk = k_ref.shape[1]
-    nkb = tk // bk
+    p = pl.program_id(1)
+    d = q_ref.shape[2]
+    flags = flags_ref[p]
+    is_first, is_last, needs_mask = flags & 1, flags & 2, flags & 4
 
-    cdt = compute_dtype or q_ref.dtype
-    q = q_ref[0].astype(cdt)  # (bq, d)
-    q_row0 = iq * bq
+    @pl.when(is_first != 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
+    q = q_ref[0]  # (bq, d), input dtype
+    kb = k_ref[0]
+    vb = v_ref[0]
+    s = (
+        lax.dot_general(q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        * scale
+    )  # (bq, bk) f32
 
-    def body(j, carry):
-        acc, m, l = carry
-        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(cdt)  # (bk, d)
-        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(cdt)
-        s = (
-            lax.dot_general(q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-            * scale
-        )  # (bq, bk) f32
-        if causal:
-            rows = q_row0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+    def _update(s):
+        m = m_ref[...]
         m_blk = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp(s - m_new)
+        p_tile = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p_tile, axis=1, keepdims=True)
         # probabilities ride the MXU in the value dtype (standard flash practice;
         # p ∈ [0,1] so the bf16 round-off is bounded), accumulation stays f32
-        acc_new = acc * corr + lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        acc_ref[...] = acc_ref[...] * corr + lax.dot_general(
+            p_tile.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc_new, m_new, l_new
+        m_ref[...] = m_new
 
-    # causal: only k-blocks intersecting [0, q_row0 + bq) contribute; the trip
-    # count depends only on the grid position, so whole above-diagonal blocks
-    # are skipped rather than masked
-    upper = jnp.minimum((q_row0 + bq + bk - 1) // bk, nkb) if causal else nkb
-    acc, m, l = lax.fori_loop(0, upper, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    # log-sum-exp residual for the backward pass: L = m + log(l)
-    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    # only diagonal-straddling blocks pay the iota/where mask; fully-below
+    # blocks take the plain branch — pl.when predication, not a lane-wise select,
+    # so the mask cost really is skipped for them
+    @pl.when(needs_mask != 0)
+    def _masked():
+        rows = im_ref[p] * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = jm_ref[p] * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        _update(jnp.where(rows >= cols, s, _NEG_INF))
+
+    @pl.when(needs_mask == 0)
+    def _plain():
+        _update(s)
+
+    @pl.when(is_last != 0)
+    def _finalize():
+        l = l_ref[...]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # log-sum-exp residual for the backward pass: L = m + log(l)
+        lse_ref[0] = m_ref[...] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _pair_schedule(nq: int, nk: int, bq: int, bk: int, causal: bool):
+    """Flattened (i, j) visit list + per-step flag bits (1=first of row sweep,
+    2=last of row sweep, 4=diagonal-straddling → mask). Causal keeps only blocks
+    with any (row ≥ col); mask is needed only when the block's last col exceeds
+    the block's first row."""
+    im, jm, flags = [], [], []
+    for i in range(nq):
+        js = [
+            j for j in range(nk)
+            if not causal or j * bk <= i * bq + bq - 1
+        ]
+        for idx, j in enumerate(js):
+            f = (1 if idx == 0 else 0) | (2 if idx == len(js) - 1 else 0)
+            if causal and (j * bk + bk - 1 > i * bq):
+                f |= 4
+            im.append(i)
+            jm.append(j)
+            flags.append(f)
+    import numpy as np
+
+    return np.asarray(im, np.int32), np.asarray(jm, np.int32), np.asarray(flags, np.int32)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "bq", "bk", "interpret", "compute_dtype")
+    jax.jit, static_argnames=("causal", "scale", "bq", "bk", "interpret")
 )
 def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
-                  interpret: bool = False, compute_dtype=None):
+                  interpret: bool = False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -125,113 +189,176 @@ def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
         kr = k.reshape(bh, tk, d)
         vr = v.reshape(bh, tk, d)
 
-        out, lse = pl.pallas_call(
-            functools.partial(_kernel, scale=scale, causal=causal, bk=bk,
-                              compute_dtype=compute_dtype),
-            grid=(bh, tq // bq),
+        im, jm, flags = _pair_schedule(tq // bq, tk // bk, bq, bk, causal)
+        npairs = len(im)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(bh, npairs),
             in_specs=[
-                pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
+                pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
+                pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
+                pl.BlockSpec((1, bq, 1), lambda b, p, im, jm, fl: (b, im[p], 0)),
             ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, d), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+            ],
+        )
+        out, lse = pl.pallas_call(
+            functools.partial(_kernel, scale=scale, bq=bq, bk=bk),
+            grid_spec=grid_spec,
             out_shape=[
                 jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
                 jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
             ],
             interpret=interpret,
-        )(qr, kr, vr)
+        )(jnp.asarray(im), jnp.asarray(jm), jnp.asarray(flags), qr, kr, vr)
         return out.reshape(*batch, tq, d), lse.reshape(*batch, tq)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, *,
-               scale: float, causal: bool, bk: int):
-    """dq_i = Σ_j dS_ij · k_j · scale with dS = P ∘ (dO·Vᵀ − D)."""
+def _dq_kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               dd_ref, dq_ref, acc_ref, *, scale: float, bq: int, bk: int):
+    """dq_i = Σ_j dS_ij · k_j · scale with dS = P ∘ (dO·Vᵀ − D).
+
+    Streams k/v blocks over the same flattened (i, j) pair grid as the forward;
+    the dq accumulator lives in VMEM scratch across each row sweep, so only
+    O(bq·bk) is resident regardless of T."""
     import jax.experimental.pallas as pl
 
-    iq = pl.program_id(1)
-    bq, d = q_ref.shape[1], q_ref.shape[2]
-    tk = k_ref.shape[1]
-    nkb = tk // bk
+    p = pl.program_id(1)
+    flags = flags_ref[p]
+    is_first, is_last, needs_mask = flags & 1, flags & 2, flags & 4
 
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    @pl.when(is_first != 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0]  # (bq, 1)
     dd = dd_ref[0]
-    q_row0 = iq * bq
+    s = (
+        lax.dot_general(q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        * scale
+    )
 
-    def body(j, dq):
-        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        s = (
-            lax.dot_general(q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-            * scale
-        )
-        if causal:
-            rows = q_row0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse)  # (bq, bk), exact probabilities via the saved LSE
+    def _update(s):
+        p_tile = jnp.exp(s - lse)  # exact probabilities via the saved LSE
         dp = lax.dot_general(do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - dd)
-        return dq + lax.dot_general(
+        ds = (p_tile * (dp - dd)).astype(kb.dtype)
+        acc_ref[...] = acc_ref[...] + lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    upper = jnp.minimum((q_row0 + bq + bk - 1) // bk, nkb) if causal else nkb
-    dq = lax.fori_loop(0, upper, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(needs_mask != 0)
+    def _masked():
+        rows = im_ref[p] * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = jm_ref[p] * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        _update(jnp.where(rows >= cols, s, _NEG_INF))
+
+    @pl.when(needs_mask == 0)
+    def _plain():
+        _update(s)
+
+    @pl.when(is_last != 0)
+    def _finalize():
+        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dk_ref, dv_ref, *,
-                scale: float, causal: bool, bq: int):
-    """dk_j = Σ_i dSᵀ_ij · q_i · scale,  dv_j = Σ_i Pᵀ_ij · dO_i."""
+def _dkv_kernel(jm_ref, im_ref, flags_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                dd_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                scale: float, bq: int, bk: int):
+    """dk_j = Σ_i dSᵀ_ij · q_i · scale,  dv_j = Σ_i Pᵀ_ij · dO_i.
+
+    Streams q/dO/LSE blocks over a kv-major flattened (j, i) pair grid with the
+    dk/dv accumulators in VMEM scratch — no full-panel residency."""
     import jax.experimental.pallas as pl
 
-    jk = pl.program_id(1)
-    bk, d = k_ref.shape[1], k_ref.shape[2]
-    tq = q_ref.shape[1]
-    nqb = tq // bq
+    p = pl.program_id(1)
+    flags = flags_ref[p]
+    is_first, is_last, needs_mask = flags & 1, flags & 2, flags & 4
+    is_zero = flags & 8  # causal, Tk > Tq: no query attends this k-block
 
-    kb = k_ref[0].astype(jnp.float32)
-    vb = v_ref[0].astype(jnp.float32)
-    k_row0 = jk * bk
+    @pl.when(is_first != 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        dob = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * bq, bq), :]  # (bq, 1)
-        dd = dd_ref[0, pl.ds(i * bq, bq), :]
-        s = (
-            lax.dot_general(qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-            * scale
-        )
-        if causal:
-            rows = i * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = k_row0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse)
+    qb = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    dob = do_ref[0]
+    lse = lse_ref[0]  # (bq, 1)
+    dd = dd_ref[0]
+    s = (
+        lax.dot_general(qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        * scale
+    )
+
+    def _update(s):
+        p_tile = jnp.exp(s - lse)
         dp = lax.dot_general(dob, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - dd)
-        dk_new = dk + lax.dot_general(
+        ds = (p_tile * (dp - dd)).astype(qb.dtype)
+        dk_acc_ref[...] = dk_acc_ref[...] + lax.dot_general(
             ds, qb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        dv_new = dv + lax.dot_general(
-            p, dob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        dv_acc_ref[...] = dv_acc_ref[...] + lax.dot_general(
+            p_tile.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        return dk_new, dv_new
 
-    # causal: only q-blocks at or below this k-block's first row contribute
-    lower = (k_row0 // bq) if causal else 0
-    dk, dv = lax.fori_loop(
-        lower, nqb, body, (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
-    )
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(needs_mask != 0)
+    def _masked():
+        rows = im_ref[p] * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = jm_ref[p] * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        _update(jnp.where(rows >= cols, s, _NEG_INF))
+
+    @pl.when((needs_mask == 0) & (is_zero == 0))
+    def _plain():
+        _update(s)
+
+    @pl.when(is_last != 0)
+    def _finalize():
+        dk_ref[0] = (dk_acc_ref[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _pair_schedule_kv(nq: int, nk: int, bq: int, bk: int, causal: bool):
+    """kv-major visit list for the dk/dv kernel: for each k-block j, the q-blocks
+    i that attend to it (all of them when not causal; those at or beyond the
+    diagonal otherwise). Same flag bits as :func:`_pair_schedule`, plus bit 8 =
+    no query attends this k-block (causal with Tk > Tq): the step only writes
+    zero gradients — without it those output blocks would hold uninitialized
+    memory, since an unvisited grid block is never written."""
+    jm, im, flags = [], [], []
+    for j in range(nk):
+        is_ = [
+            i for i in range(nq)
+            if not causal or i * bq + bq - 1 >= j * bk
+        ]
+        if not is_:
+            jm.append(j)
+            im.append(0)
+            flags.append(1 | 2 | 8)
+            continue
+        for idx, i in enumerate(is_):
+            f = (1 if idx == 0 else 0) | (2 if idx == len(is_) - 1 else 0)
+            if causal and (j * bk + bk - 1 > i * bq):
+                f |= 4
+            jm.append(j)
+            im.append(i)
+            flags.append(f)
+    import numpy as np
+
+    return np.asarray(jm, np.int32), np.asarray(im, np.int32), np.asarray(flags, np.int32)
 
 
 @functools.partial(
@@ -257,43 +384,58 @@ def _flash_bwd_pallas(q, k, v, o, do, lse, causal: bool, scale: float, bq: int,
             axis=-1, keepdims=True,
         )
 
-        common = dict(interpret=interpret)
-        dq = pl.pallas_call(
-            functools.partial(_dq_kernel, scale=scale, causal=causal, bk=bk),
-            grid=(bh, tq // bq),
+        im, jm, flags = _pair_schedule(tq // bq, tk // bk, bq, bk, causal)
+        dq_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(bh, len(im)),
             in_specs=[
-                pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
+                pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
+                pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
+                pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
+                pl.BlockSpec((1, bq, 1), lambda b, p, im, jm, fl: (b, im[p], 0)),
+                pl.BlockSpec((1, bq, 1), lambda b, p, im, jm, fl: (b, im[p], 0)),
             ],
-            out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            out_specs=pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        )
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, scale=scale, bq=bq, bk=bk),
+            grid_spec=dq_spec,
             out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            **common,
-        )(qr, kr, vr, dor, lser, dd)
-        dk, dv = pl.pallas_call(
-            functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq),
-            grid=(bh, tk // bk),
+            interpret=interpret,
+        )(jnp.asarray(im), jnp.asarray(jm), jnp.asarray(flags), qr, kr, vr, dor, lser, dd)
+
+        jm2, im2, flags2 = _pair_schedule_kv(tq // bq, tk // bk, bq, bk, causal)
+        dkv_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(bh, len(jm2)),
             in_specs=[
-                pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, tq, 1), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, tq, 1), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bq, d), lambda b, p, jm, im, fl: (b, im[p], 0)),
+                pl.BlockSpec((1, bk, d), lambda b, p, jm, im, fl: (b, jm[p], 0)),
+                pl.BlockSpec((1, bk, d), lambda b, p, jm, im, fl: (b, jm[p], 0)),
+                pl.BlockSpec((1, bq, d), lambda b, p, jm, im, fl: (b, im[p], 0)),
+                pl.BlockSpec((1, bq, 1), lambda b, p, jm, im, fl: (b, im[p], 0)),
+                pl.BlockSpec((1, bq, 1), lambda b, p, jm, im, fl: (b, im[p], 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, bk, d), lambda b, p, jm, im, fl: (b, jm[p], 0)),
+                pl.BlockSpec((1, bk, d), lambda b, p, jm, im, fl: (b, jm[p], 0)),
             ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+        )
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, scale=scale, bq=bq, bk=bk),
+            grid_spec=dkv_spec,
             out_shape=[
                 jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
                 jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
             ],
-            **common,
-        )(qr, kr, vr, dor, lser, dd)
+            interpret=interpret,
+        )(jnp.asarray(jm2), jnp.asarray(im2), jnp.asarray(flags2), qr, kr, vr, dor, lser, dd)
         return (
             dq.reshape(*batch, tq, d),
             dk.reshape(*batch, tk, d),
@@ -302,45 +444,50 @@ def _flash_bwd_pallas(q, k, v, o, do, lse, causal: bool, scale: float, bq: int,
 
 
 def _fits(q, k, bq: int, bk: int) -> bool:
-    """VMEM gate: the worst-resident kernel is the dk/dv backward, which keeps the
-    full q and dO (plus k/v blocks and score tiles) in VMEM. Shapes must also tile
-    evenly (pad upstream if not)."""
+    """VMEM gate: forward and backward all stream blocks through the grid now, so
+    residency is O(bq·bk) regardless of T — the gate only enforces even tiling
+    and a sane per-step footprint."""
     tq, d = q.shape[-2], q.shape[-1]
     tk = k.shape[-2]
     if tq % bq or tk % bk:
         return False
     if tq % _BWD_BQ or tk % _BWD_BK:
         return False
+    # the flattened pair schedules are O((T/b)²) int32 scalar-prefetch entries
+    # living in SMEM — bound them (bwd uses the fixed _BWD blocks, check both)
+    if (tq // bq) * (tk // bk) > _MAX_PAIRS:
+        return False
+    if (tq // _BWD_BQ) * (tk // _BWD_BK) > _MAX_PAIRS:
+        return False
     itemsize = jnp.dtype(q.dtype).itemsize
-    fwd = 4 * (3 * bq * d + 3 * bq * bk) + 2 * tk * d * itemsize
-    bwd = (
-        4 * (4 * _BWD_BQ * d + 3 * _BWD_BQ * _BWD_BK)
-        + 4 * max(tk, tq) * d * itemsize  # full q + dO resident in the dk/dv kernel
-    )
-    return max(fwd, bwd) <= 10 * 2**20
+    # per-step residency: s + p tiles (f32), accumulator, double-buffered blocks
+    fwd = 8 * bq * bk + 4 * bq * d + 2 * (bq + 2 * bk) * d * itemsize * 2
+    bwd = 8 * _BWD_BQ * _BWD_BK + 8 * _BWD_BK * d \
+        + 2 * (_BWD_BQ + 2 * _BWD_BK) * d * itemsize * 2
+    return max(fwd, bwd) <= 12 * 2**20
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, causal: bool = False, scale=None):
     """Exact attention with the flash (streaming-VMEM) forward on TPU.
 
-    q: (..., Tq, D), k/v: (..., Tk, D); Tq/Tk must be multiples of the 512-block
-    (callers fall back to the XLA path otherwise via :func:`use_flash`). The
-    backward is the flash backward (two Pallas kernels over the saved (O, LSE)
-    residuals) — neither direction ever materializes the (T, T) matrix in HBM.
+    q: (..., Tq, D), k/v: (..., Tk, D); Tq/Tk must be multiples of the block
+    sizes (callers fall back to the XLA path otherwise via :func:`use_flash`).
+    The backward is the flash backward (two Pallas kernels over the saved
+    (O, LSE) residuals). All three kernels stream blocks through a flattened
+    pair grid, so VMEM residency is O(block²) regardless of T — arbitrarily
+    long sequences fit, and the (T, T) matrix never exists in HBM.
     """
     s = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    # f32 compute wins on this shape class: at head_dim 64 the kernel is VPU-bound
-    # (exp + rescale on (bq,bk) tiles), and bf16 MXU passes don't pay for the extra
-    # relayouts (measured 17.3 vs 15.0 TFLOP/s at b8·h16·t4096·d64 on v5e, 3× the
-    # jax.experimental.pallas.ops.tpu library kernel on the same workload)
-    out, _ = _flash_pallas(q, k, v, causal, float(s), _BQ, _BK, compute_dtype=jnp.float32)
+    blocks = _fwd_blocks(q.dtype, q.shape[-2], k.shape[-2])
+    out, _ = _flash_pallas(q, k, v, causal, float(s), *blocks)
     return out
 
 
 def _fwd(q, k, v, causal, scale):
     s = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    out, lse = _flash_pallas(q, k, v, causal, float(s), _BQ, _BK, compute_dtype=jnp.float32)
+    blocks = _fwd_blocks(q.dtype, q.shape[-2], k.shape[-2])
+    out, lse = _flash_pallas(q, k, v, causal, float(s), *blocks)
     return out, (q, k, v, out, lse)
 
 
@@ -369,4 +516,4 @@ def use_flash(q, k, v, mask, scale=None, interpret: bool = False) -> bool:
         return False
     if not interpret and jax.default_backend() != "tpu":
         return False
-    return _fits(q, k, _BQ, _BK)
+    return _fits(q, k, *_fwd_blocks(q.dtype, q.shape[-2], k.shape[-2]))
