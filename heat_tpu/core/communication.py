@@ -29,9 +29,42 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import os
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Multi-controller bootstrap must run BEFORE anything touches the XLA backend —
+# and importing heat_tpu itself does (the COMM_WORLD mesh below calls
+# jax.devices()). The launcher therefore passes the coordination parameters by
+# environment, the TPU-native analogue of mpirun's environment contract:
+#
+#   HEAT_TPU_COORDINATOR_ADDRESS=host:port \
+#   HEAT_TPU_NUM_PROCESSES=N HEAT_TPU_PROCESS_ID=i python program.py
+#
+# Programs that want to call :func:`initialize` explicitly must do so before
+# importing heat_tpu (i.e. call jax.distributed.initialize themselves).
+if os.environ.get("HEAT_TPU_COORDINATOR_ADDRESS"):
+    _missing = [
+        name
+        for name in ("HEAT_TPU_NUM_PROCESSES", "HEAT_TPU_PROCESS_ID")
+        if not os.environ.get(name)
+    ]
+    if _missing:
+        raise RuntimeError(
+            "HEAT_TPU_COORDINATOR_ADDRESS is set but "
+            f"{' and '.join(_missing)} {'is' if len(_missing) == 1 else 'are'} not; "
+            "the multi-controller launch contract needs all three of "
+            "HEAT_TPU_COORDINATOR_ADDRESS, HEAT_TPU_NUM_PROCESSES, "
+            "HEAT_TPU_PROCESS_ID"
+        )
+    if jax._src.distributed.global_state.client is None:  # not already initialized
+        jax.distributed.initialize(
+            coordinator_address=os.environ["HEAT_TPU_COORDINATOR_ADDRESS"],
+            num_processes=int(os.environ["HEAT_TPU_NUM_PROCESSES"]),
+            process_id=int(os.environ["HEAT_TPU_PROCESS_ID"]),
+        )
 
 __all__ = [
     "Communication",
@@ -403,6 +436,13 @@ def sanitize_comm(comm: Optional[Communication]) -> MeshCommunication:
 def initialize(**kwargs) -> None:
     """Multi-host bootstrap: ``jax.distributed.initialize`` replaces the mpirun launcher
     (reference launches via ``mpirun -np N python script.py``, ``scripts/heat_test.py:1-9``).
+
+    NOTE: must run before anything initialises the XLA backend — and importing
+    ``heat_tpu`` does. The supported launch paths are therefore (a) the
+    ``HEAT_TPU_COORDINATOR_ADDRESS`` / ``HEAT_TPU_NUM_PROCESSES`` /
+    ``HEAT_TPU_PROCESS_ID`` environment contract, honoured automatically at
+    import (see module header), or (b) calling ``jax.distributed.initialize``
+    yourself before the first ``import heat_tpu``.
 
     Multi-controller contract (every process runs the same program, SPMD):
 

@@ -70,6 +70,18 @@ def _is_writer() -> bool:
     return jax.process_index() == 0
 
 
+def _writer_barrier(tag: str) -> None:
+    """Block every controller until the single writer's file is on disk, so
+    ``ht.save(...)`` followed by ``ht.load(...)`` is race-free on all processes
+    (the reference gets this ordering from MPI-IO's collective writes)."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"heat_tpu.io:{tag}")
+
+
 def _sharded_read(data, gshape, np_dtype, split: int, comm):
     """Per-shard hyperslab reads of an indexable file dataset (reference io.py:211-238).
 
@@ -239,6 +251,7 @@ if _HAS_HDF5:
             if _is_writer():
                 with h5py.File(path, mode) as handle:
                     handle.create_dataset(dataset, data=value, **kwargs)
+            _writer_barrier(f"save_hdf5:{path}")
             return
         with h5py.File(path, mode) as handle:
             dset = handle.create_dataset(dataset, data.gshape, dtype=np.dtype(data.dtype.jax_type()), **kwargs)
@@ -280,16 +293,16 @@ if _HAS_NETCDF:
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
         value = data.numpy()
-        if not _is_writer():
-            return
-        with nc.Dataset(path, mode) as handle:
-            dims = []
-            for i, s in enumerate(data.gshape):
-                name = f"dim_{variable}_{i}"
-                handle.createDimension(name, s)
-                dims.append(name)
-            var = handle.createVariable(variable, np.dtype(data.dtype.jax_type()), tuple(dims))
-            var[...] = value
+        if _is_writer():
+            with nc.Dataset(path, mode) as handle:
+                dims = []
+                for i, s in enumerate(data.gshape):
+                    name = f"dim_{variable}_{i}"
+                    handle.createDimension(name, s)
+                    dims.append(name)
+                var = handle.createVariable(variable, np.dtype(data.dtype.jax_type()), tuple(dims))
+                var[...] = value
+        _writer_barrier(f"save_netcdf:{path}")
 
 
 def load_csv(
@@ -385,16 +398,16 @@ def save_csv(
     if data.ndim > 2:
         raise ValueError("CSV can only store 1-D or 2-D arrays")
     arr = data.numpy()
-    if not _is_writer():
-        return
-    if decimals >= 0:
-        fmt = f"%.{decimals}f"
-    elif np.issubdtype(arr.dtype, np.integer):
-        fmt = "%d"
-    else:
-        fmt = "%.18e"
-    header = "\n".join(header_lines) if header_lines else ""
-    np.savetxt(path, arr.reshape(arr.shape[0], -1), delimiter=sep, fmt=fmt, header=header, comments="")
+    if _is_writer():
+        if decimals >= 0:
+            fmt = f"%.{decimals}f"
+        elif np.issubdtype(arr.dtype, np.integer):
+            fmt = "%d"
+        else:
+            fmt = "%.18e"
+        header = "\n".join(header_lines) if header_lines else ""
+        np.savetxt(path, arr.reshape(arr.shape[0], -1), delimiter=sep, fmt=fmt, header=header, comments="")
+    _writer_barrier(f"save_csv:{path}")
 
 
 def load_npy(path: str, dtype=None, split: Optional[int] = None, device=None, comm=None) -> DNDarray:
@@ -408,6 +421,7 @@ def save_npy(data: DNDarray, path: str) -> None:
     arr = data.numpy()
     if _is_writer():
         np.save(path, arr)
+    _writer_barrier(f"save_npy:{path}")
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:

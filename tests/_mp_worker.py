@@ -1,0 +1,102 @@
+"""Multi-controller worker: one SPMD process of an N-process heat_tpu job.
+
+Launched by tests/test_multiprocess.py with
+``python _mp_worker.py <coordinator> <num_processes> <process_id> <tmpdir>``.
+Exercises the multi-controller branches that single-process runs (even with 8
+virtual devices) can never reach: ``jax.distributed.initialize`` bootstrap,
+``is_split`` per-process ingest (factories), cross-host ``numpy()`` collection,
+``MeshCommunication.process_rank``, and the single-writer save/load contract
+(io). Prints ``WORKER_OK <pid>`` on success; any assertion failure exits
+non-zero and fails the parent test. Mirrors the reference's ``mpirun -n N
+pytest`` mode of execution (reference .github/workflows/ci.yaml:65-66).
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, nprocs, pid, tmpdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    # the env contract honoured by heat_tpu at import (communication.py header)
+    os.environ["HEAT_TPU_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["HEAT_TPU_NUM_PROCESSES"] = str(nprocs)
+    os.environ["HEAT_TPU_PROCESS_ID"] = str(pid)
+
+    import numpy as np
+
+    import heat_tpu as ht
+    import jax
+
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert jax.process_index() == pid
+
+    comm = ht.get_comm()
+    assert comm.process_rank == pid
+    ndev = comm.size
+    assert ndev == nprocs * len(jax.local_devices()), (ndev, jax.local_devices())
+    # rank = first shard index owned by this controller (communication.py:127-133)
+    assert comm.rank == pid * len(jax.local_devices()), comm.rank
+
+    # --- is_split ingest: every process contributes its own chunk -------------
+    per, cols = 6, 5
+    global_ref = np.arange(nprocs * per * cols, dtype=np.float32).reshape(
+        nprocs * per, cols
+    )
+    local = global_ref[pid * per : (pid + 1) * per]
+    a = ht.array(local, is_split=0)
+    assert tuple(a.gshape) == global_ref.shape, a.gshape
+    assert a.split == 0
+    assert not a.larray.is_fully_addressable  # genuinely cross-host
+
+    # --- psum-backed reduction over the cross-host array ----------------------
+    total = float(a.sum().item())
+    assert total == float(global_ref.sum()), (total, global_ref.sum())
+    colsum = a.sum(axis=0).numpy()
+    np.testing.assert_allclose(colsum, global_ref.sum(axis=0))
+
+    # --- elementwise + matmul stay correct across hosts -----------------------
+    b = ht.array(local * 2.0, is_split=0)
+    np.testing.assert_allclose((a + b).numpy(), global_ref * 3.0)
+    mm = ht.matmul(a.T, b)
+    np.testing.assert_allclose(
+        mm.numpy(), global_ref.T @ (global_ref * 2.0), rtol=1e-5
+    )
+
+    # --- cross-host collection: identical global value on every process -------
+    got = a.numpy()
+    np.testing.assert_array_equal(got, global_ref)
+
+    # --- is_split sanity: disagreeing non-split dims must raise ---------------
+    try:
+        bad_cols = cols + (1 if pid == 0 else 0)
+        ht.array(np.zeros((per, bad_cols), np.float32), is_split=0)
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised, "disagreeing non-split dims must raise"
+
+    # --- single-writer save + collective load ---------------------------------
+    if ht.io.supports_hdf5():
+        path = os.path.join(tmpdir, "mp.h5")
+        ht.save_hdf5(a, path, "data")
+        loaded = ht.load_hdf5(path, dataset="data", split=0)
+        np.testing.assert_allclose(loaded.numpy(), global_ref)
+    path_npy = os.path.join(tmpdir, "mp.npy")
+    ht.io.save_npy(a, path_npy)
+    loaded2 = ht.io.load_npy(path_npy, split=0)
+    np.testing.assert_allclose(loaded2.numpy(), global_ref)
+
+    # --- replicated ingest of a global value (comm.shard callback path) -------
+    r = ht.array(global_ref, split=0)
+    np.testing.assert_allclose(r.numpy(), global_ref)
+    assert float((r - a).abs().max().item()) == 0.0
+
+    print(f"WORKER_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

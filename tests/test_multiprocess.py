@@ -1,0 +1,69 @@
+"""Multi-controller execution tests: launch REAL separate processes with
+``jax.distributed.initialize`` on localhost, the TPU-native analogue of the
+reference's ``mpirun -n 3/4 pytest heat/`` CI mode (reference
+.github/workflows/ci.yaml:65-66).
+
+Every other test in this suite is single-process (one controller, 8 virtual
+devices); these are the only runs where ``jax.process_count() > 1`` branches —
+``is_split`` assembly, cross-host ``numpy()``, the single-writer io contract —
+actually execute. See tests/_mp_worker.py for the per-process assertions.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _launch(nprocs: int, devices_per_proc: int, tmpdir: str):
+    coordinator = f"localhost:{_free_port()}"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",  # sitecustomize: skip TPU plugin registration
+        XLA_FLAGS=f"--xla_force_host_platform_device_count={devices_per_proc}",
+        _HEAT_TPU_TEST_REEXEC="1",  # don't re-exec inside the worker
+    )
+    env.pop("HEAT_TPU_TEST_DEVICES", None)
+    # stdout goes to files, not pipes: a failing worker with a long traceback
+    # must never block on a full pipe while its peers wait in a collective
+    logs = [os.path.join(tmpdir, f"worker{i}.log") for i in range(nprocs)]
+    handles = [open(log, "w") for log in logs]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, coordinator, str(nprocs), str(i), tmpdir],
+            env=env,
+            stdout=handles[i],
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(nprocs)
+    ]
+    try:
+        for p in procs:
+            p.wait(timeout=420)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for h in handles:
+            h.close()
+    return [(p.returncode, open(log).read()) for p, log in zip(procs, logs)]
+
+
+@pytest.mark.parametrize("nprocs,devices_per_proc", [(2, 2), (4, 1)])
+def test_multiprocess_spmd(nprocs, devices_per_proc, tmp_path):
+    outs = _launch(nprocs, devices_per_proc, str(tmp_path))
+    for i, (rc, out) in enumerate(outs):
+        assert rc == 0, f"worker {i} failed (rc={rc}):\n{out[-4000:]}"
+        assert f"WORKER_OK {i}" in out, f"worker {i} incomplete:\n{out[-4000:]}"
