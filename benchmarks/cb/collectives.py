@@ -1,0 +1,96 @@
+"""Collective micro-benchmarks: wall time plus *bytes-on-wire vs payload* for the
+communication helpers (VERDICT r2 #7: the naive masked-psum broadcast and
+all_gather exscan inflate payload by O(P); the tree/doubling forms must not).
+
+Wire bytes are read from the compiled HLO: every collective op's result shape is
+summed, so the number is what XLA actually schedules, not a model. Each benchmark
+prints one extra JSON line ``{"metric": "<name>_wire_ratio", ...}`` alongside the
+monitor's timing line.
+"""
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import heat_tpu as ht
+from benchmarks.cb.monitor import monitor
+
+ELEMS = int(os.environ.get("HEAT_TPU_BENCH_COLL_ELEMS", str(1 << 20)))  # per shard
+
+_DTYPE_BYTES = {"f32": 4, "f64": 8, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s64": 8, "u64": 8}
+# matches both the sync spelling (`f32[N] collective-permute(`) and the async TPU/GPU
+# pair (`(f32[N], ...) collective-permute-start(`) — the -done halves carry no new
+# bytes and the tuple capture below takes the first (data) element's shape
+_COLLECTIVE_RE = re.compile(
+    r"=\s*\(?([a-z]+\d+)\[([\d,]*)\][^=\n]*?"
+    r"(collective-permute|all-gather|all-reduce|all-to-all|reduce-scatter)"
+    r"(?:-start)?\("
+)
+
+
+def wire_bytes(compiled_text: str) -> int:
+    """Total bytes moved by collective ops in a compiled HLO module."""
+    total = 0
+    for line in compiled_text.splitlines():
+        if "-done(" in line:
+            continue  # the -start half already counted this transfer
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        dtype, dims, _op = m.groups()
+        elems = int(np.prod([int(d) for d in dims.split(",") if d])) if dims else 1
+        total += elems * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def _prepare(name: str, fn):
+    """Compile once at module load: the monitored fn must execute only the cached
+    computation (run_all's warmup+timed calls would otherwise time re-tracing and
+    the HLO text dump, and print the wire-ratio line twice)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    comm = ht.get_comm()
+    x = jnp.arange(ELEMS * comm.size, dtype=jnp.float32)
+    jitted = jax.jit(
+        jax.shard_map(
+            fn, mesh=comm.mesh, in_specs=P(comm.axis_name), out_specs=P(comm.axis_name)
+        )
+    )
+    hlo = jitted.lower(x).compile().as_text()
+    ratio = wire_bytes(hlo) / (ELEMS * 4)  # vs one shard's payload
+    print(
+        json.dumps(
+            {"metric": f"{name}_wire_ratio", "value": round(ratio, 2), "unit": "x payload"}
+        ),
+        flush=True,
+    )
+    return lambda: jitted(x)
+
+
+_comm = ht.get_comm()
+_run_broadcast = _prepare("broadcast_tree", lambda v: _comm.broadcast(v, root=0))
+_run_exscan = _prepare("exscan_doubling", lambda v: _comm.exscan(v))
+_run_psum = _prepare("psum_reference", lambda v: _comm.psum(v))
+
+
+@monitor("broadcast_tree")
+def broadcast_tree():
+    return _run_broadcast()
+
+
+@monitor("exscan_doubling")
+def exscan_doubling():
+    return _run_exscan()
+
+
+@monitor("psum_reference")
+def psum_reference():
+    """Baseline: a plain all-reduce of the same payload, for scale."""
+    return _run_psum()

@@ -20,6 +20,7 @@ import benchmarks.cb.cluster  # noqa: F401,E402
 import benchmarks.cb.manipulations  # noqa: F401,E402
 import benchmarks.cb.distances  # noqa: F401,E402
 import benchmarks.cb.attention  # noqa: F401,E402
+import benchmarks.cb.collectives  # noqa: F401,E402
 
 if __name__ == "__main__":
     run_all(filter_substring=os.environ.get("HEAT_TPU_BENCH_FILTER"))

@@ -85,25 +85,52 @@ def _writer_barrier(tag: str) -> None:
 def _sharded_read(data, gshape, np_dtype, split: int, comm):
     """Per-shard hyperslab reads of an indexable file dataset (reference io.py:211-238).
 
-    Evenly divisible shapes go through ``jax.make_array_from_callback`` — it invokes the
-    callback once per *addressable* shard, so each process reads only its own slabs
-    straight into device buffers. Ragged shapes (which that API rejects) fall back to
-    slab-wise assembly in a host buffer + a padded GSPMD reshard.
+    All shapes go through ``jax.make_array_from_callback`` — it invokes the callback
+    once per *addressable* shard, so each process reads only its own slabs straight
+    into device buffers and host memory stays O(local) for ANY extent. Ragged split
+    extents (which that API rejects) read on the zero-padded canonical grid
+    (``ceil(n/P)·P``) and slice back to the true extent on device; the sliced result
+    is replicated by GSPMD (jax cannot represent a ragged NamedSharding — see the
+    deviations doc), but no process ever materialises the global array on host.
     """
     import jax
 
+    ndim = len(gshape)
+    split = split % ndim  # the slice-back below compares positional indices
     if gshape[split] % comm.size == 0:
         return jax.make_array_from_callback(
             gshape,
-            comm.sharding(len(gshape), split),
+            comm.sharding(ndim, split),
             lambda idx: np.asarray(data[idx], dtype=np_dtype),
         )
-    arr = np.empty(gshape, dtype=np_dtype)
-    for r in range(comm.size):
-        _, lshape, slices = comm.chunk(gshape, split, rank=r)
-        if 0 not in lshape:
-            arr[slices] = data[slices]
-    return arr
+    n = gshape[split]
+    c = -(-n // comm.size)
+    padded = list(gshape)
+    padded[split] = c * comm.size
+
+    def _read_shard(idx):
+        starts = [s.start or 0 for s in idx]
+        stops = [s.stop if s.stop is not None else padded[i] for i, s in enumerate(idx)]
+        shard_shape = tuple(hi - lo for lo, hi in zip(starts, stops))
+        real_stop = min(stops[split], n)
+        if real_stop <= starts[split]:
+            return np.zeros(shard_shape, np_dtype)  # fully in the padding
+        src = list(idx)
+        src[split] = slice(starts[split], real_stop)
+        block = np.asarray(data[tuple(src)], dtype=np_dtype)
+        if real_stop == stops[split]:
+            return block
+        buf = np.zeros(shard_shape, np_dtype)
+        out = [slice(None)] * ndim
+        out[split] = slice(0, real_stop - starts[split])
+        buf[tuple(out)] = block
+        return buf
+
+    padded_arr = jax.make_array_from_callback(
+        tuple(padded), comm.sharding(ndim, split), _read_shard
+    )
+    cut = tuple(slice(0, n) if i == split else slice(None) for i in range(ndim))
+    return padded_arr[cut]
 
 
 def supports_hdf5() -> bool:
@@ -372,15 +399,19 @@ def load_csv(
 
     if split != 0 or comm.size == 1:
         arr = parse_rows(0, nrows).reshape(gshape)
-    else:
-        # split=0: each shard parses only its own byte range (reference io.py:780-905)
-        arr = np.empty(gshape, dtype=np_dtype)
-        for r in range(comm.size):
-            _, lshape, slices = comm.chunk(gshape, 0, rank=r)
-            if lshape[0] > 0:
-                lo = slices[0].start
-                arr[slices] = parse_rows(lo, lo + lshape[0]).reshape(lshape)
-    return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+        return factories.array(arr, dtype=dtype, split=split, device=device, comm=comm)
+
+    # split=0: each shard decodes+parses only its own byte range, straight into its
+    # device buffer (reference io.py:780-905) — host memory stays O(local rows)
+    class _RowReader:
+        def __getitem__(self, idx):
+            row_sl = idx[0]
+            lo, hi = row_sl.start or 0, row_sl.stop if row_sl.stop is not None else nrows
+            block = parse_rows(lo, hi).reshape((hi - lo,) + gshape[1:])
+            return block[(slice(None),) + tuple(idx[1:])]
+
+    value = _sharded_read(_RowReader(), gshape, np_dtype, 0, comm)
+    return factories.array(value, dtype=dtype, split=split, device=device, comm=comm)
 
 
 def save_csv(
