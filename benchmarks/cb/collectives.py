@@ -23,61 +23,76 @@ from benchmarks.cb.monitor import monitor
 ELEMS = int(os.environ.get("HEAT_TPU_BENCH_COLL_ELEMS", str(1 << 20)))  # per shard
 
 _DTYPE_BYTES = {"f32": 4, "f64": 8, "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "s64": 8, "u64": 8}
-# matches both the sync spelling (`f32[N] collective-permute(`) and the async TPU/GPU
-# pair (`(f32[N], ...) collective-permute-start(`) — the -done halves carry no new
-# bytes and the tuple capture below takes the first (data) element's shape
-_COLLECTIVE_RE = re.compile(
-    r"=\s*\(?([a-z]+\d+)\[([\d,]*)\][^=\n]*?"
-    r"(collective-permute|all-gather|all-reduce|all-to-all|reduce-scatter)"
+_COLLECTIVE_LINE_RE = re.compile(
+    r"=\s*(.*?)\s*"
+    r"(?:collective-permute|all-gather|all-reduce|all-to-all|reduce-scatter)"
     r"(?:-start)?\("
 )
+_SHAPE_RE = re.compile(r"([a-z]+\d+)\[([\d,]*)\]")
 
 
 def wire_bytes(compiled_text: str) -> int:
-    """Total bytes moved by collective ops in a compiled HLO module."""
+    """Total bytes moved by collective ops in a compiled HLO module.
+
+    Handles both the sync spelling (``f32[N] all-gather(``) and the async TPU/GPU
+    pair (``(f32[n], f32[N]) all-gather-start(`` + ``-done``): the ``-done`` half is
+    skipped, and of a ``-start`` tuple the LARGEST element is billed — for
+    all-gather that is the gathered output (the input-shard element would
+    undercount by P×), for collective-permute input and output coincide.
+    """
     total = 0
     for line in compiled_text.splitlines():
         if "-done(" in line:
             continue  # the -start half already counted this transfer
-        m = _COLLECTIVE_RE.search(line)
+        m = _COLLECTIVE_LINE_RE.search(line)
         if not m:
             continue
-        dtype, dims, _op = m.groups()
-        elems = int(np.prod([int(d) for d in dims.split(",") if d])) if dims else 1
-        total += elems * _DTYPE_BYTES.get(dtype, 4)
+        best = 0
+        for dtype, dims in _SHAPE_RE.findall(m.group(1)):
+            elems = int(np.prod([int(d) for d in dims.split(",") if d])) if dims else 1
+            best = max(best, elems * _DTYPE_BYTES.get(dtype, 4))
+        total += best
     return total
 
 
 def _prepare(name: str, fn):
-    """Compile once at module load: the monitored fn must execute only the cached
-    computation (run_all's warmup+timed calls would otherwise time re-tracing and
-    the HLO text dump, and print the wire-ratio line twice)."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
+    """Lazy one-shot compile, cached in the closure: run_all's warmup call pays the
+    trace/compile/HLO-dump and prints the wire-ratio line once; the timed call runs
+    only the cached computation. Nothing compiles at import, so filtered benchmark
+    runs (HEAT_TPU_BENCH_FILTER) don't pay for, or emit metrics from, benchmarks
+    that never run."""
+    state: dict = {}
 
-    comm = ht.get_comm()
-    x = jnp.arange(ELEMS * comm.size, dtype=jnp.float32)
-    jitted = jax.jit(
-        jax.shard_map(
-            fn, mesh=comm.mesh, in_specs=P(comm.axis_name), out_specs=P(comm.axis_name)
-        )
-    )
-    hlo = jitted.lower(x).compile().as_text()
-    ratio = wire_bytes(hlo) / (ELEMS * 4)  # vs one shard's payload
-    print(
-        json.dumps(
-            {"metric": f"{name}_wire_ratio", "value": round(ratio, 2), "unit": "x payload"}
-        ),
-        flush=True,
-    )
-    return lambda: jitted(x)
+    def run():
+        if not state:
+            import jax
+            import jax.numpy as jnp
+            from jax.sharding import PartitionSpec as P
+
+            comm = ht.get_comm()
+            x = jnp.arange(ELEMS * comm.size, dtype=jnp.float32)
+            jitted = jax.jit(
+                jax.shard_map(
+                    fn, mesh=comm.mesh, in_specs=P(comm.axis_name), out_specs=P(comm.axis_name)
+                )
+            )
+            hlo = jitted.lower(x).compile().as_text()
+            ratio = wire_bytes(hlo) / (ELEMS * 4)  # vs one shard's payload
+            print(
+                json.dumps(
+                    {"metric": f"{name}_wire_ratio", "value": round(ratio, 2), "unit": "x payload"}
+                ),
+                flush=True,
+            )
+            state["call"] = lambda: jitted(x)
+        return state["call"]()
+
+    return run
 
 
-_comm = ht.get_comm()
-_run_broadcast = _prepare("broadcast_tree", lambda v: _comm.broadcast(v, root=0))
-_run_exscan = _prepare("exscan_doubling", lambda v: _comm.exscan(v))
-_run_psum = _prepare("psum_reference", lambda v: _comm.psum(v))
+_run_broadcast = _prepare("broadcast_tree", lambda v: ht.get_comm().broadcast(v, root=0))
+_run_exscan = _prepare("exscan_doubling", lambda v: ht.get_comm().exscan(v))
+_run_psum = _prepare("psum_reference", lambda v: ht.get_comm().psum(v))
 
 
 @monitor("broadcast_tree")

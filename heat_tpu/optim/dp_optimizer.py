@@ -220,7 +220,12 @@ class DASO:
         self.warmup_epochs = warmup_epochs
         self.cooldown_epochs = cooldown_epochs
         self.scheduler = scheduler
-        self.stability = stability_level
+        # the reference's plateau detector drives the skip schedule
+        # (dp_optimizer.py:244: DetectMetricPlateau(patience=2, threshold=level))
+        from .utils import DetectMetricPlateau
+
+        self.stability = DetectMetricPlateau(patience=2, threshold=stability_level)
+        self.stability_level = stability_level
         self.max_global_skips = max_global_skips
         self.sending_chunk_size = sending_chunk_size
         self.downcast_type = downcast_type
@@ -233,7 +238,6 @@ class DASO:
         self.batches_to_wait = 0
         self.epoch = 0
         self._batch_in_epoch = 0
-        self._prev_losses: list = []
         self._phase = "warmup"
         if warmup_epochs == 0:
             self._start_cycling()
@@ -262,40 +266,66 @@ class DASO:
 
     def reset(self) -> None:
         """Reset the phase machine to its base state (reference ``:711``)."""
+        self.stability.reset()
         self.global_skip = 0
         self.local_skip = 0
         self.batches_to_wait = 0
         self.epoch = 0
         self._batch_in_epoch = 0
-        self._prev_losses = []
         self._phase = "warmup"
         if self.warmup_epochs == 0:
             self._start_cycling()
 
     # ------------------------------------------------------------------ phase machine
     def _start_cycling(self) -> None:
+        # cycling begins at the reference's post-warmup schedule
+        # (dp_optimizer.py:392-396: gs=4, ls=1, btw=1), capped by the user's max;
+        # the plateau rule then cycles between 1 and max_global_skips
         self._phase = "cycling"
-        self.global_skip = self.max_global_skips
-        self.local_skip = max(self.max_global_skips // self.local_skip_factor, 1)
+        self.global_skip = min(4, self.max_global_skips)
+        self.local_skip = 1
         self.batches_to_wait = 1
 
     def epoch_loss_logic(self, loss, loss_globally_averaged: bool = False) -> None:
-        """Skip-decay on loss plateau (reference ``:684``): when the running loss has
-        stabilised, halve ``global_skips`` (never below 1 during cycling)."""
+        """Drive the skip schedule from the epoch's training loss (reference ``:354``).
+
+        The loss is averaged across controllers (reference Allreduce ``:372-377``)
+        unless ``loss_globally_averaged``; the plateau detector
+        (:class:`~heat_tpu.optim.utils.DetectMetricPlateau`, patience 2) then
+        decides: on plateau with ``global_skip > 1`` divide the skips by
+        ``skip_reduction_factor`` and shorten the wait (reference ``:421-436``); on
+        plateau at ``global_skip == 1`` cycle back up to ``max_global_skips``
+        (reference ``:437-442``) — synchronising often while the loss moves, rarely
+        once it stalls again."""
         loss_value = float(_to_value(loss))
-        self._prev_losses.append(loss_value)
-        if len(self._prev_losses) < 3 or self._phase != "cycling":
+        if not loss_globally_averaged:
+            import jax
+
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                vals = multihost_utils.process_allgather(jnp.float32(loss_value))
+                loss_value = float(np.mean(np.asarray(vals)))
+        if self._phase != "cycling":
             return
-        window = self._prev_losses[-3:]
-        mean = sum(window) / len(window)
-        if mean == 0:
-            return
-        spread = (max(window) - min(window)) / abs(mean)
-        if spread < self.stability and self.global_skip > 1:
+        stable = self.stability.test_if_improving(loss_value)
+        if stable and self.global_skip > 1:
+            # floor at 1 so the schedule always reaches the cycle-up branch below,
+            # whatever skip_reduction_factor is (a 0 here would disable cycling
+            # forever and pin the run to per-batch global syncs)
             self.global_skip = max(self.global_skip // self.skip_reduction_factor, 1)
-            self.local_skip = max(self.global_skip // self.local_skip_factor, 1)
+            self.local_skip = max(self.local_skip // self.skip_reduction_factor, 1)
+            self.batches_to_wait = max(self.batches_to_wait - 1, 1)
             if self.verbose:
-                self.print0(f"DASO: loss stabilised, global_skip -> {self.global_skip}")
+                self.print0(f"DASO: plateau, dropping skips -> {self.global_skip}")
+        elif stable and self.global_skip == 1:
+            self.global_skip = self.max_global_skips
+            self.local_skip = max(self.max_global_skips // self.local_skip_factor, 1)
+            self.batches_to_wait = max(self.max_global_skips // self.local_skip_factor, 1)
+            if self.verbose:
+                self.print0(
+                    f"DASO: plateau at skip 1, cycling up -> {self.global_skip}"
+                )
 
     def epoch_end(self) -> None:
         """Advance the phase machine at the end of an epoch (reference ``:747-832``)."""

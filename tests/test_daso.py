@@ -198,11 +198,28 @@ class TestDASOSync:
 
     @needs_4
     def test_epoch_loss_logic_decays_skips(self):
+        """Reference :421-442: a plateaued loss halves the skips (patience 2);
+        plateauing again at global_skip=1 cycles back up to max_global_skips."""
         daso, model, loss_fn = _make_daso(warmup_epochs=0, max_global_skips=8)
-        assert daso.global_skip == 8
+        # cycling starts at the reference's post-warmup schedule (gs=4, ls=1, btw=1)
+        assert daso.global_skip == 4
+        assert daso.local_skip == 1 and daso.batches_to_wait == 1
         for _ in range(4):
             daso.epoch_loss_logic(1.0)  # perfectly stable loss
-        assert daso.global_skip < 8
+        assert daso.global_skip == 2
+        for _ in range(4):
+            daso.epoch_loss_logic(1.0)
+        assert daso.global_skip == 1
+        # plateau at 1 -> cycle back up to max (reference :437-442)
+        for _ in range(4):
+            daso.epoch_loss_logic(1.0)
+        assert daso.global_skip == 8
+        assert daso.batches_to_wait == 8 // daso.local_skip_factor
+        # an improving loss leaves the schedule alone
+        gs = daso.global_skip
+        for v in (0.9, 0.8, 0.7):
+            daso.epoch_loss_logic(v)
+        assert daso.global_skip == gs
 
 
 class TestDetectMetricPlateau:
