@@ -67,6 +67,14 @@ def _unwrap(x):
     return (x.larray, x) if isinstance(x, DNDarray) else (x, None)
 
 
+def _p(x):
+    """Unwrap a parameter-like argument (weight/bias/running stats): DNDarrays are
+    legal everywhere a tensor is in torch's functional API — the reference layer IS
+    torch, so ``F.conv2d(ht_array, ht_weight)`` must work, not raise from deep
+    inside XLA."""
+    return x.larray if isinstance(x, DNDarray) else x
+
+
 def _rewrap(value, proto: Optional[DNDarray], split_rule="batch"):
     if proto is None:
         return value
@@ -92,8 +100,19 @@ def _elementwise(fn):
 
 
 relu = _elementwise(jax.nn.relu)
-gelu = _elementwise(jax.nn.gelu)
 elu = _elementwise(jax.nn.elu)
+
+
+_gelu_impl = _elementwise(jax.nn.gelu)
+
+
+def gelu(x, approximate: str = "none"):
+    """torch.nn.functional.gelu: EXACT erf form by default (jax.nn.gelu defaults to
+    the tanh approximation — ~1e-3 divergence from the reference's torch numerics);
+    pass approximate='tanh' for the fast form."""
+    if approximate not in ("none", "tanh"):
+        raise ValueError(f"approximate must be 'none' or 'tanh', got {approximate!r}")
+    return _gelu_impl(x, approximate=(approximate == "tanh"))
 sigmoid = _elementwise(jax.nn.sigmoid)
 tanh = _elementwise(jnp.tanh)
 
@@ -119,6 +138,7 @@ def log_softmax(x, dim: int = -1):
 def linear(x, weight, bias=None):
     """``y = x @ W.T + b`` with torch's (out, in) weight layout."""
     v, proto = _unwrap(x)
+    weight, bias = _p(weight), _p(bias)
     out = v @ weight.T
     if bias is not None:
         out = out + bias
@@ -128,6 +148,7 @@ def linear(x, weight, bias=None):
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups: int = 1):
     """2-D convolution, torch semantics: x (N,C,H,W), weight (O, C/groups, kH, kW)."""
     v, proto = _unwrap(x)
+    weight, bias = _p(weight), _p(bias)
     sh, sw = _pair(stride)
     ph, pw = _pair(padding)
     dh, dw = _pair(dilation)
@@ -212,6 +233,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     maintain running estimates (jax arrays are immutable; there is no in-place
     buffer update like torch's)."""
     v, proto = _unwrap(x)
+    running_mean, running_var = _p(running_mean), _p(running_var)
+    weight, bias = _p(weight), _p(bias)
     axes = (0,) + tuple(range(2, v.ndim))
     if training or running_mean is None:
         mean = jnp.mean(v, axis=axes)
@@ -230,6 +253,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, eps: float = 1e-5):
     v, proto = _unwrap(x)
+    weight, bias = _p(weight), _p(bias)
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     axes = tuple(range(v.ndim - len(normalized_shape), v.ndim))
@@ -324,6 +348,7 @@ def embedding(x, weight, padding_idx: Optional[int] = None):
     gradient (torch zeroes its grad every backward), so a zero-initialized padding
     row stays exactly zero for the whole training run."""
     v, proto = _unwrap(x)
+    weight = _p(weight)
     out = jnp.take(weight, v.astype(jnp.int32), axis=0)
     if padding_idx is not None:
         # block exactly the cotangents that would scatter-add into the padding row —
@@ -342,6 +367,7 @@ def embedding(x, weight, padding_idx: Optional[int] = None):
 def group_norm(x, num_groups: int, weight=None, bias=None, eps: float = 1e-5):
     """torch.nn.functional.group_norm over (N, C, *spatial)."""
     v, proto = _unwrap(x)
+    weight, bias = _p(weight), _p(bias)
     n, c = v.shape[:2]
     if c % num_groups:
         raise ValueError(f"num_channels {c} not divisible by num_groups {num_groups}")
@@ -366,6 +392,7 @@ def conv_transpose2d(x, weight, bias=None, stride=1, padding=0, output_padding=0
     by ``stride``, convolve with the spatially-flipped, in/out-swapped kernel.
     """
     v, proto = _unwrap(x)
+    weight, bias = _p(weight), _p(bias)
     sh, sw = _pair(stride)
     ph, pw = _pair(padding)
     oph, opw = _pair(output_padding)

@@ -379,8 +379,16 @@ class LeakyReLU(Module):
 
 
 class GELU(Module):
+    """torch.nn.GELU: exact erf form by default, ``approximate='tanh'`` for the
+    fast approximation (jax.nn.gelu's default is the tanh form — not torch's)."""
+
+    def __init__(self, approximate: str = "none"):
+        if approximate not in ("none", "tanh"):
+            raise ValueError(f"approximate must be 'none' or 'tanh', got {approximate!r}")
+        self.approximate = approximate
+
     def apply(self, params, x, *, key=None, train=False):
-        return jax.nn.gelu(x)
+        return jax.nn.gelu(x, approximate=(self.approximate == "tanh"))
 
 
 class ELU(Module):

@@ -226,11 +226,13 @@ class TestDASO(TestCase):
         for _ in range(2):
             daso.epoch_end()
         self.assertEqual(daso._phase, "cycling")
-        self.assertEqual(daso.global_skip, 8)
-        # plateaued loss halves the skips
-        for loss in (1.0, 1.0, 1.0):
-            daso.epoch_loss_logic(loss)
+        # cycling starts at the reference's post-warmup schedule (gs=4, reference
+        # dp_optimizer.py:392-396); the plateau detector then cycles 4 -> 1 -> max
         self.assertEqual(daso.global_skip, 4)
+        # plateaued loss (patience 2) halves the skips on the 4th stale epoch
+        for loss in (1.0, 1.0, 1.0, 1.0):
+            daso.epoch_loss_logic(loss)
+        self.assertEqual(daso.global_skip, 2)
         for _ in range(6):
             daso.epoch_end()
         self.assertEqual(daso._phase, "cooldown")

@@ -1,0 +1,197 @@
+"""Randomized torch-parity fuzz for the nn functional layer.
+
+The reference nn layer IS torch (heat delegates every module/functional to
+torch.nn, reference nn/__init__.py:18-31), so torch-cpu is the exact oracle for
+heat_tpu.nn.functional: conv/pool geometry (stride/padding/dilation/groups),
+norm statistics, loss reductions, activations. Random shapes per numbered seed
+— failures print a reproducible case id.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as tF  # noqa: E402
+
+import heat_tpu as ht  # noqa: E402
+import heat_tpu.nn.functional as F  # noqa: E402
+
+N_CASES = 12
+
+
+def _chk(got, want_t, case, rtol=1e-4, atol=1e-4):
+    g = got.numpy() if isinstance(got, ht.DNDarray) else np.asarray(got)
+    w = want_t.detach().numpy()
+    assert g.shape == tuple(w.shape), f"case {case}: {g.shape} vs {tuple(w.shape)}"
+    np.testing.assert_allclose(g, w, rtol=rtol, atol=atol, err_msg=f"case {case}")
+
+
+class TestConvPoolFuzz:
+    @pytest.mark.parametrize("case", range(N_CASES))
+    def test_conv2d_geometry(self, case):
+        rng = np.random.default_rng(100 + case)
+        groups = int(rng.choice([1, 1, 2]))
+        cin = int(rng.integers(1, 4)) * groups
+        cout = int(rng.integers(1, 4)) * groups
+        kh, kw = int(rng.integers(1, 4)), int(rng.integers(1, 4))
+        stride = int(rng.integers(1, 3))
+        padding = int(rng.integers(0, 3))
+        dilation = int(rng.integers(1, 3))
+        h = int(rng.integers((kh - 1) * dilation + 1, 14))
+        w = int(rng.integers((kw - 1) * dilation + 1, 14))
+        n = int(rng.integers(1, 4))
+        x = rng.standard_normal((n, cin, h, w)).astype(np.float32)
+        wgt = rng.standard_normal((cout, cin // groups, kh, kw)).astype(np.float32)
+        b = rng.standard_normal(cout).astype(np.float32)
+        got = F.conv2d(
+            ht.array(x), ht.array(wgt), ht.array(b),
+            stride=stride, padding=padding, dilation=dilation, groups=groups,
+        )
+        want = tF.conv2d(
+            torch.tensor(x), torch.tensor(wgt), torch.tensor(b),
+            stride=stride, padding=padding, dilation=dilation, groups=groups,
+        )
+        _chk(got, want, f"{case} g{groups} s{stride} p{padding} d{dilation}")
+
+    @pytest.mark.parametrize("case", range(N_CASES))
+    def test_pools(self, case):
+        rng = np.random.default_rng(200 + case)
+        n, c = int(rng.integers(1, 3)), int(rng.integers(1, 4))
+        h, w = int(rng.integers(4, 14)), int(rng.integers(4, 14))
+        k = int(rng.integers(1, 4))
+        stride = int(rng.integers(1, 3))
+        padding = int(rng.integers(0, (k // 2) + 1))
+        x = rng.standard_normal((n, c, h, w)).astype(np.float32)
+        got = F.max_pool2d(ht.array(x), k, stride=stride, padding=padding)
+        want = tF.max_pool2d(torch.tensor(x), k, stride=stride, padding=padding)
+        _chk(got, want, case)
+        got = F.avg_pool2d(ht.array(x), k, stride=stride, padding=padding)
+        want = tF.avg_pool2d(torch.tensor(x), k, stride=stride, padding=padding)
+        _chk(got, want, case)
+        oh, ow = int(rng.integers(1, h + 1)), int(rng.integers(1, w + 1))
+        got = F.adaptive_avg_pool2d(ht.array(x), (oh, ow))
+        want = tF.adaptive_avg_pool2d(torch.tensor(x), (oh, ow))
+        _chk(got, want, f"{case} adaptive {oh}x{ow}")
+
+    @pytest.mark.parametrize("case", range(N_CASES // 2))
+    def test_conv_transpose2d(self, case):
+        rng = np.random.default_rng(300 + case)
+        cin, cout = int(rng.integers(1, 4)), int(rng.integers(1, 4))
+        k = int(rng.integers(1, 4))
+        stride = int(rng.integers(1, 3))
+        padding = int(rng.integers(0, k))
+        output_padding = int(rng.integers(0, stride))
+        x = rng.standard_normal((2, cin, 7, 6)).astype(np.float32)
+        wgt = rng.standard_normal((cin, cout, k, k)).astype(np.float32)
+        got = F.conv_transpose2d(
+            ht.array(x), ht.array(wgt), stride=stride, padding=padding,
+            output_padding=output_padding,
+        )
+        want = tF.conv_transpose2d(
+            torch.tensor(x), torch.tensor(wgt), stride=stride, padding=padding,
+            output_padding=output_padding,
+        )
+        _chk(got, want, f"{case} k{k} s{stride} p{padding} op{output_padding}")
+
+
+class TestNormLossFuzz:
+    @pytest.mark.parametrize("case", range(N_CASES))
+    def test_norms(self, case):
+        rng = np.random.default_rng(400 + case)
+        n, c, h, w = 3, int(rng.integers(2, 7)), 5, 4
+        x = rng.standard_normal((n, c, h, w)).astype(np.float32)
+        weight = rng.standard_normal(c).astype(np.float32)
+        bias = rng.standard_normal(c).astype(np.float32)
+        rm = rng.standard_normal(c).astype(np.float32)
+        rv = rng.random(c).astype(np.float32) + 0.5
+        got, _, _ = F.batch_norm(
+            ht.array(x), ht.array(rm.copy()), ht.array(rv.copy()),
+            ht.array(weight), ht.array(bias), training=False,
+        )  # returns (out, mean, var): jax can't mutate running stats in place
+        want = tF.batch_norm(
+            torch.tensor(x), torch.tensor(rm), torch.tensor(rv),
+            torch.tensor(weight), torch.tensor(bias), training=False,
+        )
+        _chk(got, want, case)
+        got = F.layer_norm(ht.array(x), (c, h, w))
+        want = tF.layer_norm(torch.tensor(x), (c, h, w))
+        _chk(got, want, case)
+        if c % 2 == 0:
+            gw = rng.standard_normal(c).astype(np.float32)
+            got = F.group_norm(ht.array(x), 2, ht.array(gw))
+            want = tF.group_norm(torch.tensor(x), 2, torch.tensor(gw))
+            _chk(got, want, case)
+
+    @pytest.mark.parametrize("case", range(N_CASES))
+    def test_losses_all_reductions(self, case):
+        rng = np.random.default_rng(500 + case)
+        n, k = int(rng.integers(2, 12)), int(rng.integers(2, 7))
+        logits = rng.standard_normal((n, k)).astype(np.float32)
+        target = rng.integers(0, k, n)
+        pred = rng.standard_normal((n, k)).astype(np.float32)
+        tgt = rng.standard_normal((n, k)).astype(np.float32)
+        prob = rng.random((n, k)).astype(np.float32) * 0.98 + 0.01
+        for red in ("mean", "sum", "none"):
+            case_id = f"{case} {red}"
+            _chk(
+                F.cross_entropy(ht.array(logits), ht.array(target), reduction=red),
+                tF.cross_entropy(torch.tensor(logits), torch.tensor(target), reduction=red),
+                case_id,
+            )
+            _chk(
+                F.mse_loss(ht.array(pred), ht.array(tgt), reduction=red),
+                tF.mse_loss(torch.tensor(pred), torch.tensor(tgt), reduction=red),
+                case_id,
+            )
+            _chk(
+                F.l1_loss(ht.array(pred), ht.array(tgt), reduction=red),
+                tF.l1_loss(torch.tensor(pred), torch.tensor(tgt), reduction=red),
+                case_id,
+            )
+            _chk(
+                F.smooth_l1_loss(ht.array(pred), ht.array(tgt), reduction=red, beta=0.7),
+                tF.smooth_l1_loss(torch.tensor(pred), torch.tensor(tgt), reduction=red, beta=0.7),
+                case_id,
+            )
+            _chk(
+                F.huber_loss(ht.array(pred), ht.array(tgt), reduction=red, delta=1.3),
+                tF.huber_loss(torch.tensor(pred), torch.tensor(tgt), reduction=red, delta=1.3),
+                case_id,
+            )
+            _chk(
+                F.binary_cross_entropy(ht.array(prob), ht.array((tgt > 0).astype(np.float32)), reduction=red),
+                tF.binary_cross_entropy(torch.tensor(prob), torch.tensor((tgt > 0).astype(np.float32)), reduction=red),
+                case_id,
+            )
+            _chk(
+                F.binary_cross_entropy_with_logits(ht.array(pred), ht.array((tgt > 0).astype(np.float32)), reduction=red),
+                tF.binary_cross_entropy_with_logits(torch.tensor(pred), torch.tensor((tgt > 0).astype(np.float32)), reduction=red),
+                case_id,
+            )
+
+    @pytest.mark.parametrize("case", range(N_CASES // 2))
+    def test_activations(self, case):
+        rng = np.random.default_rng(600 + case)
+        x = rng.standard_normal((5, 9)).astype(np.float32) * 4
+        pairs = [
+            (lambda v: F.softmax(v, dim=1), lambda v: tF.softmax(v, dim=1)),
+            (lambda v: F.log_softmax(v, dim=1), lambda v: tF.log_softmax(v, dim=1)),
+            (lambda v: F.leaky_relu(v, 0.07), lambda v: tF.leaky_relu(v, 0.07)),
+            (lambda v: F.softplus(v, beta=1.4), lambda v: tF.softplus(v, beta=1.4)),
+            (lambda v: F.hardtanh(v, -0.6, 0.8), lambda v: tF.hardtanh(v, -0.6, 0.8)),
+            (F.gelu, tF.gelu),
+            (lambda v: F.gelu(v, approximate="tanh"), lambda v: tF.gelu(v, approximate="tanh")),
+        ]
+        for fh, ft in pairs:
+            _chk(fh(ht.array(x)), ft(torch.tensor(x)), case)
+
+    @pytest.mark.parametrize("case", range(N_CASES // 2))
+    def test_embedding_padding_idx(self, case):
+        rng = np.random.default_rng(700 + case)
+        vocab, dim = int(rng.integers(4, 12)), int(rng.integers(2, 6))
+        idx = rng.integers(0, vocab, (3, 5))
+        wgt = rng.standard_normal((vocab, dim)).astype(np.float32)
+        pad_idx = int(rng.integers(0, vocab))
+        got = F.embedding(ht.array(idx), ht.array(wgt), padding_idx=pad_idx)
+        want = tF.embedding(torch.tensor(idx), torch.tensor(wgt), padding_idx=pad_idx)
+        _chk(got, want, case)
