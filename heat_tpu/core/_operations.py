@@ -272,9 +272,12 @@ def cum_op(
     axis = sanitize_axis(x.gshape, axis)
     if axis is None:
         raise NotImplementedError("cumulative operations require an explicit axis")
-    result = operation(x.larray, axis=axis, **fn_kwargs)
+    value = x.larray
     if dtype is not None:
-        result = _safe_astype(result, types.canonical_heat_type(dtype).jax_type())
+        # numpy semantics: dtype is the ACCUMULATOR type — cast before the scan so
+        # e.g. an int8 cumsum with dtype=int64 accumulates without overflow
+        value = _safe_astype(value, types.canonical_heat_type(dtype).jax_type())
+    result = operation(value, axis=axis, **fn_kwargs)
     if out is not None:
         sanitation.sanitize_out(out, x.gshape, x.split, x.device)
         out.larray = x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)

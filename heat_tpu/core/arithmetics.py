@@ -93,22 +93,24 @@ def bitwise_not(t, out=None) -> DNDarray:
     return _operations.local_op(jnp.bitwise_not, t, out)
 
 
-invert = bitwise_not
+def invert(a, out=None) -> DNDarray:
+    """Bitwise NOT (reference ``arithmetics.py:1720``); alias of bitwise_not."""
+    return bitwise_not(a, out)
 
 
-def copysign(t1, t2, out=None, where=None) -> DNDarray:
-    return _operations.binary_op(jnp.copysign, t1, t2, out, where)
+def copysign(a, b, out=None, where=None) -> DNDarray:
+    return _operations.binary_op(jnp.copysign, a, b, out, where)
 
 
-def cumsum(a: DNDarray, axis: int, out=None) -> DNDarray:
+def cumsum(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
     """Cumulative sum along ``axis`` (reference via ``__cum_op``; the Exscan carry across
-    shards is lowered by XLA)."""
-    return _operations.cum_op(jnp.cumsum, a, axis, out)
+    shards is lowered by XLA). ``dtype`` sets the accumulator/result type."""
+    return _operations.cum_op(jnp.cumsum, a, axis, out, dtype=dtype)
 
 
-def cumprod(a: DNDarray, axis: int, out=None) -> DNDarray:
-    """Cumulative product along ``axis``."""
-    return _operations.cum_op(jnp.cumprod, a, axis, out)
+def cumprod(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative product along ``axis``. ``dtype`` sets the accumulator/result type."""
+    return _operations.cum_op(jnp.cumprod, a, axis, out, dtype=dtype)
 
 
 cumproduct = cumprod
@@ -168,18 +170,18 @@ def fmod(t1, t2, out=None, where=None) -> DNDarray:
     return _operations.binary_op(jnp.fmod, t1, t2, out, where)
 
 
-def gcd(t1, t2, out=None, where=None) -> DNDarray:
-    _require_ints(t1, t2)
-    return _operations.binary_op(jnp.gcd, t1, t2, out, where)
+def gcd(a, b, out=None, where=None) -> DNDarray:
+    _require_ints(a, b)
+    return _operations.binary_op(jnp.gcd, a, b, out, where)
 
 
-def hypot(t1, t2, out=None, where=None) -> DNDarray:
-    return _operations.binary_op(jnp.hypot, t1, t2, out, where)
+def hypot(a, b, out=None, where=None) -> DNDarray:
+    return _operations.binary_op(jnp.hypot, a, b, out, where)
 
 
-def lcm(t1, t2, out=None, where=None) -> DNDarray:
-    _require_ints(t1, t2)
-    return _operations.binary_op(jnp.lcm, t1, t2, out, where)
+def lcm(a, b, out=None, where=None) -> DNDarray:
+    _require_ints(a, b)
+    return _operations.binary_op(jnp.lcm, a, b, out, where)
 
 
 def left_shift(t1, t2, out=None, where=None) -> DNDarray:

@@ -38,12 +38,12 @@ def log1p(x, out=None) -> DNDarray:
     return _operations.local_op(jnp.log1p, x, out)
 
 
-def logaddexp(t1, t2, out=None, where=None) -> DNDarray:
-    return _operations.binary_op(jnp.logaddexp, t1, t2, out, where)
+def logaddexp(x1, x2, out=None, where=None) -> DNDarray:
+    return _operations.binary_op(jnp.logaddexp, x1, x2, out, where)
 
 
-def logaddexp2(t1, t2, out=None, where=None) -> DNDarray:
-    return _operations.binary_op(jnp.logaddexp2, t1, t2, out, where)
+def logaddexp2(x1, x2, out=None, where=None) -> DNDarray:
+    return _operations.binary_op(jnp.logaddexp2, x1, x2, out, where)
 
 
 def sqrt(x, out=None) -> DNDarray:

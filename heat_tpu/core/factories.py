@@ -202,11 +202,17 @@ def array(
     return _wrap(value, dtype, split, device, comm)
 
 
-def asarray(obj, dtype=None, copy=None, order="C", device=None) -> DNDarray:
+def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None) -> DNDarray:
     """Convert to DNDarray, no-copy when possible (reference ``factories.py:463``)."""
-    if isinstance(obj, DNDarray) and (dtype is None or obj.dtype is types.canonical_heat_type(dtype)):
+    if (
+        is_split is None
+        and copy is not True
+        and isinstance(obj, DNDarray)
+        and (dtype is None or obj.dtype is types.canonical_heat_type(dtype))
+        and (device is None or obj.device == sanitize_device(device))
+    ):
         return obj
-    return array(obj, dtype=dtype, copy=copy, order=order, device=device)
+    return array(obj, dtype=dtype, copy=copy, order=order, is_split=is_split, device=device)
 
 
 def __factory(shape, dtype, split, maker, device, comm, order="C") -> DNDarray:
@@ -275,27 +281,38 @@ def __factory_like(a, dtype, split, factory, device, comm, **kwargs) -> DNDarray
     return factory(shape, dtype=dtype, split=split, device=device, comm=comm, **kwargs)
 
 
-def empty_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+def _sanitize_order(order: str) -> None:
+    """Same stance as :func:`array`: row-major only on TPU; anything else is loud."""
+    if order not in ("C", "K", None):
+        raise NotImplementedError("only row-major memory layout is supported on TPU")
+
+
+def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    _sanitize_order(order)
     return __factory_like(a, dtype, split, empty, device, comm)
 
 
-def zeros_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+def zeros_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    _sanitize_order(order)
     return __factory_like(a, dtype, split, zeros, device, comm)
 
 
-def ones_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    _sanitize_order(order)
     return __factory_like(a, dtype, split, ones, device, comm)
 
 
-def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    _sanitize_order(order)
     shape = a.shape if isinstance(a, (DNDarray, np.ndarray, jax.Array)) else np.asarray(a).shape
     if split is None and isinstance(a, DNDarray):
         split = a.split
     return full(shape, fill_value, dtype=dtype, split=split, device=device, comm=comm)
 
 
-def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Identity-like 2-D array (reference ``factories.py:865``)."""
+    _sanitize_order(order)
     if isinstance(shape, (int, np.integer)):
         n, m = int(shape), int(shape)
     else:
@@ -379,17 +396,17 @@ def from_partitioned(x, comm=None) -> DNDarray:
     return from_partition_dict(parts, comm=comm)
 
 
-def from_partition_dict(parts: dict, comm=None) -> DNDarray:
+def from_partition_dict(parted: dict, comm=None) -> DNDarray:
     """Build a DNDarray from a ``__partitioned__`` dict (reference ``factories.py:868``)."""
     comm = sanitize_comm(comm)
-    shape = tuple(parts["shape"])
-    getter = parts.get("get", lambda v: v)
-    tiling = tuple(parts.get("partition_tiling", (1,) * len(shape)))
+    shape = tuple(parted["shape"])
+    getter = parted.get("get", lambda v: v)
+    tiling = tuple(parted.get("partition_tiling", (1,) * len(shape)))
     split_dims = [i for i, t in enumerate(tiling) if t > 1]
     if len(split_dims) > 1:
         raise ValueError(f"Only one split-dimension allowed, got {len(split_dims)}")
     split = split_dims[0] if split_dims else None
-    ordered = sorted(parts["partitions"].items(), key=lambda kv: kv[1]["start"])
+    ordered = sorted(parted["partitions"].items(), key=lambda kv: kv[1]["start"])
     locals_ = [np.asarray(getter(p["data"])) for _, p in ordered if p["data"] is not None]
     if split is None:
         value = jnp.asarray(locals_[0])

@@ -231,14 +231,14 @@ def _tri_op(a: DNDarray, k: int, op) -> DNDarray:
     return _operations.local_op(op, a, k=k)
 
 
-def tril(a: DNDarray, k: int = 0) -> DNDarray:
+def tril(m: DNDarray, k: int = 0) -> DNDarray:
     """Lower triangle (reference ``basics.py:2197``)."""
-    return _tri_op(a, k, jnp.tril)
+    return _tri_op(m, k, jnp.tril)
 
 
-def triu(a: DNDarray, k: int = 0) -> DNDarray:
+def triu(m: DNDarray, k: int = 0) -> DNDarray:
     """Upper triangle (reference ``basics.py:2220``)."""
-    return _tri_op(a, k, jnp.triu)
+    return _tri_op(m, k, jnp.triu)
 
 
 def vector_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:

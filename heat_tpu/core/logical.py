@@ -74,20 +74,20 @@ def isposinf(x, out=None) -> DNDarray:
     return _operations.local_op(jnp.isposinf, x, out)
 
 
-def logical_and(t1, t2) -> DNDarray:
-    return _operations.binary_op(jnp.logical_and, t1, t2)
+def logical_and(x, y) -> DNDarray:
+    return _operations.binary_op(jnp.logical_and, x, y)
 
 
-def logical_not(t, out=None) -> DNDarray:
-    return _operations.local_op(jnp.logical_not, t, out)
+def logical_not(x, out=None) -> DNDarray:
+    return _operations.local_op(jnp.logical_not, x, out)
 
 
-def logical_or(t1, t2) -> DNDarray:
-    return _operations.binary_op(jnp.logical_or, t1, t2)
+def logical_or(x, y) -> DNDarray:
+    return _operations.binary_op(jnp.logical_or, x, y)
 
 
-def logical_xor(t1, t2) -> DNDarray:
-    return _operations.binary_op(jnp.logical_xor, t1, t2)
+def logical_xor(x, y) -> DNDarray:
+    return _operations.binary_op(jnp.logical_xor, x, y)
 
 
 def signbit(x, out=None) -> DNDarray:

@@ -10,54 +10,54 @@ from .dndarray import DNDarray
 __all__ = ["eq", "equal", "ge", "greater", "greater_equal", "gt", "le", "less", "less_equal", "lt", "ne", "not_equal"]
 
 
-def eq(t1, t2) -> DNDarray:
+def eq(x, y) -> DNDarray:
     """Element-wise ``==`` (reference ``relational.py`` eq)."""
-    return _operations.binary_op(jnp.equal, t1, t2)
+    return _operations.binary_op(jnp.equal, x, y)
 
 
-def equal(t1, t2) -> bool:
+def equal(x, y) -> bool:
     """True iff all elements equal — a collective scalar verdict (reference
     ``relational.py`` equal, which Allreduces the local verdicts)."""
     from . import factories
 
-    a = t1 if isinstance(t1, DNDarray) else factories.array(t1)
-    b = t2 if isinstance(t2, DNDarray) else factories.array(t2)
+    a = x if isinstance(x, DNDarray) else factories.array(x)
+    b = y if isinstance(y, DNDarray) else factories.array(y)
     try:
         return bool(jnp.array_equal(a.larray, b.larray))
     except (TypeError, ValueError):
         return False
 
 
-def ge(t1, t2) -> DNDarray:
-    return _operations.binary_op(jnp.greater_equal, t1, t2)
+def ge(x, y) -> DNDarray:
+    return _operations.binary_op(jnp.greater_equal, x, y)
 
 
 greater_equal = ge
 
 
-def gt(t1, t2) -> DNDarray:
-    return _operations.binary_op(jnp.greater, t1, t2)
+def gt(x, y) -> DNDarray:
+    return _operations.binary_op(jnp.greater, x, y)
 
 
 greater = gt
 
 
-def le(t1, t2) -> DNDarray:
-    return _operations.binary_op(jnp.less_equal, t1, t2)
+def le(x, y) -> DNDarray:
+    return _operations.binary_op(jnp.less_equal, x, y)
 
 
 less_equal = le
 
 
-def lt(t1, t2) -> DNDarray:
-    return _operations.binary_op(jnp.less, t1, t2)
+def lt(x, y) -> DNDarray:
+    return _operations.binary_op(jnp.less, x, y)
 
 
 less = lt
 
 
-def ne(t1, t2) -> DNDarray:
-    return _operations.binary_op(jnp.not_equal, t1, t2)
+def ne(x, y) -> DNDarray:
+    return _operations.binary_op(jnp.not_equal, x, y)
 
 
 not_equal = ne

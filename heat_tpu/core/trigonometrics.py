@@ -77,8 +77,8 @@ def arctanh(x, out=None) -> DNDarray:
 atanh = arctanh
 
 
-def arctan2(t1, t2, out=None, where=None) -> DNDarray:
-    return _operations.binary_op(jnp.arctan2, t1, t2, out, where)
+def arctan2(x1, x2, out=None, where=None) -> DNDarray:
+    return _operations.binary_op(jnp.arctan2, x1, x2, out, where)
 
 
 atan2 = arctan2
