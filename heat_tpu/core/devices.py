@@ -35,6 +35,14 @@ class Device:
         return self.__device_id
 
     @property
+    def torch_device(self) -> str:
+        """Interop shim (reference ``devices.py:59`` returns the torch device
+        *string*): heat_tpu data lives in jax, so this is the torch device a host
+        copy would land on — always ``"cpu"`` (TPUs have no torch backing here).
+        The str is valid everywhere torch accepts a device argument."""
+        return "cpu"
+
+    @property
     def jax_device(self) -> Optional[jax.Device]:
         """The concrete ``jax.Device`` this label resolves to, or None if absent."""
         try:

@@ -58,6 +58,36 @@ class SplitTiles:
         """Owning shard of each tile along the split axis (reference ``:96``)."""
         return self.__tile_locations
 
+    @property
+    def lshape_map(self) -> np.ndarray:
+        """(size, ndim) map of every shard's local shape (reference ``:145``)."""
+        return self.__arr.comm.lshape_map(self.__arr.gshape, self.__arr.split)
+
+    @staticmethod
+    def set_tile_locations(split: int, tile_dims: np.ndarray, arr: DNDarray) -> np.ndarray:
+        """Owning rank of each tile along ``split`` (reference ``:109``): under the
+        canonical chunking, tile ``r`` along the split axis lives on shard ``r``;
+        tiles along other axes are fully local, encoded as the owning rank of the
+        split tile."""
+        size = arr.comm.size
+        shape = tuple(int(np.count_nonzero(np.asarray(tile_dims)[d])) for d in range(len(tile_dims)))
+        locs = np.zeros(shape, dtype=np.int64)
+        if arr.split is not None:
+            idx = [np.newaxis] * len(shape)
+            idx[split] = slice(None)
+            locs += np.arange(shape[split], dtype=np.int64)[tuple(idx)] % size
+        return locs
+
+    def get_tile_size(self, key) -> Tuple[int, ...]:
+        """Extent of the tile(s) selected by ``key`` (reference ``:283``)."""
+        return tuple(
+            int(
+                (s.stop if s.stop is not None else self.__arr.gshape[d])
+                - (s.start or 0)
+            )
+            for d, s in enumerate(self._tile_slices(key))
+        )
+
     def _tile_slices(self, key) -> Tuple[slice, ...]:
         if not isinstance(key, tuple):
             key = (key,)
@@ -182,15 +212,78 @@ class SquareDiagTiles:
     def tile_rows_per_process(self) -> List[int]:
         return self.__tile_rows_per_process
 
-    def get_tile_size(self, key: Tuple[int, int]) -> Tuple[int, int]:
+    @property
+    def tile_columns_per_process(self) -> List[int]:
+        """Number of tile columns on each process (reference ``:765``): with a
+        row split every process sees every tile column; with a column split each
+        process owns its ``tiles_per_proc`` columns."""
+        size = self.__arr.comm.size
+        if self.__arr.split == 1:
+            owned = [0] * size
+            for j in range(self.tile_columns):
+                owned[int(self.__tile_map[0, j])] += 1
+            return owned
+        return [self.tile_columns] * size
+
+    @property
+    def lshape_map(self) -> np.ndarray:
+        """(size, 2) map of every shard's local shape (reference ``:736``)."""
+        return self.__arr.comm.lshape_map(self.__arr.gshape, self.__arr.split)
+
+    @property
+    def last_diagonal_process(self) -> int:
+        """Rank owning the last tile on the diagonal (reference ``:744``)."""
+        k = min(self.tile_rows, self.tile_columns) - 1
+        return int(self.__tile_map[k, k])
+
+    def _normalize_key(self, key) -> Tuple:
+        """Reference key forms (``:821,1017``): a bare int means a whole tile row;
+        tuple entries may be ints or slices over tile indices."""
+        if not isinstance(key, tuple):
+            key = (key, slice(None))
+        if len(key) == 1:
+            key = (key[0], slice(None))
+        return key
+
+    def _span(self, part, inds: List[int], cuts: List[int]) -> Tuple[int, int]:
+        """Global [start, stop) covered by an int or slice of tile indices."""
+        n = len(cuts)
+        if isinstance(part, slice):
+            lo, hi, step = part.indices(n)
+            if step != 1 or hi <= lo:
+                raise ValueError(f"tile slices must be contiguous, got {part}")
+        else:
+            lo, hi = int(part), int(part) + 1
+        return inds[lo], inds[hi - 1] + cuts[hi - 1]
+
+    def get_start_stop(self, key) -> Tuple[int, int, int, int]:
+        """(row start, row stop, col start, col stop) of the tile(s) at the global
+        ``key`` (reference ``:821``); accepts a bare int (whole tile row) or
+        int/slice pairs like the reference."""
+        rs, cs = self._slices(key)
+        return rs.start, rs.stop, cs.start, cs.stop
+
+    def local_to_global(self, key, rank: int) -> Tuple:
+        """Convert a process-local tile key to global tile indices (reference
+        ``:1017``): the split axis's int index is offset by the tiles owned by
+        lower ranks; slices pass through unchanged (they already span the axis)."""
+        key = self._normalize_key(key)
         i, j = key
-        return self.__row_cuts[i], self.__col_cuts[j]
+        if self.__arr.split == 1:
+            off = int(np.sum(self.tile_columns_per_process[:rank]))
+            return i, (j if isinstance(j, slice) else j + off)
+        off = int(np.sum(self.__tile_rows_per_process[:rank]))
+        return (i if isinstance(i, slice) else i + off), j
+
+    def get_tile_size(self, key) -> Tuple[int, int]:
+        rs, cs = self._slices(key)
+        return rs.stop - rs.start, cs.stop - cs.start
 
     def _slices(self, key) -> Tuple[slice, slice]:
-        i, j = key
-        r0 = self.__row_inds[i]
-        c0 = self.__col_inds[j]
-        return slice(r0, r0 + self.__row_cuts[i]), slice(c0, c0 + self.__col_cuts[j])
+        i, j = self._normalize_key(key)
+        r0, r1 = self._span(i, self.__row_inds, self.__row_cuts)
+        c0, c1 = self._span(j, self.__col_inds, self.__col_cuts)
+        return slice(r0, r1), slice(c0, c1)
 
     def __getitem__(self, key):
         """The (i, j) tile of the global value (reference ``local_get`` ``:934``)."""
@@ -206,8 +299,8 @@ class SquareDiagTiles:
     local_get = __getitem__
     local_set = __setitem__
 
-    def match_tiles(self, other: "SquareDiagTiles") -> None:
+    def match_tiles(self, tiles_to_match: "SquareDiagTiles") -> None:
         """Align tilings for Q/R pairs (reference ``:1079``). Canonical chunkings always
         agree here, so this only validates compatibility."""
-        if self.__arr.comm.size != other.arr.comm.size:
+        if self.__arr.comm.size != tiles_to_match.arr.comm.size:
             raise ValueError("tilings live on different communicators")

@@ -160,9 +160,10 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         return wrap_result(log_prob, x, 0 if x.split is not None else None)
 
     @staticmethod
-    def logsumexp(a, axis=None, b=None, keepdims: bool = False):
-        """Stable log-sum-exp (reference ``gaussianNB.py:400``)."""
+    def logsumexp(a, axis=None, b=None, keepdims: bool = False, return_sign: bool = False):
+        """Stable log-sum-exp (reference ``gaussianNB.py:400``); ``return_sign``
+        additionally returns the sign of the sum like scipy's."""
         import jax.scipy.special as jsp
 
         av = a.larray if isinstance(a, DNDarray) else jnp.asarray(a)
-        return jsp.logsumexp(av, axis=axis, b=b, keepdims=keepdims)
+        return jsp.logsumexp(av, axis=axis, b=b, keepdims=keepdims, return_sign=return_sign)

@@ -32,16 +32,16 @@ class StandardScaler(TransformMixin, BaseEstimator):
         self.mean_ = None
         self.var_ = None
 
-    def fit(self, x: DNDarray, sample_weight=None) -> "StandardScaler":
-        _check_2d_float(x, "StandardScaler")
-        self.mean_ = ht.mean(x, axis=0) if self.with_mean or self.with_std else None
+    def fit(self, X: DNDarray, sample_weight=None) -> "StandardScaler":
+        _check_2d_float(X, "StandardScaler")
+        self.mean_ = ht.mean(X, axis=0) if self.with_mean or self.with_std else None
         if self.with_std:
-            self.var_ = ht.var(x, axis=0)
+            self.var_ = ht.var(X, axis=0)
         return self
 
-    def transform(self, x: DNDarray) -> DNDarray:
-        _check_2d_float(x, "StandardScaler")
-        out = x
+    def transform(self, X: DNDarray) -> DNDarray:
+        _check_2d_float(X, "StandardScaler")
+        out = X
         if self.with_mean:
             out = out - self.mean_
         if self.with_std:
@@ -50,8 +50,8 @@ class StandardScaler(TransformMixin, BaseEstimator):
             out = out / safe.astype(out.dtype)
         return out
 
-    def inverse_transform(self, y: DNDarray) -> DNDarray:
-        out = y
+    def inverse_transform(self, Y: DNDarray) -> DNDarray:
+        out = Y
         if self.with_std:
             out = out * ht.sqrt(self.var_).astype(out.dtype)
         if self.with_mean:
@@ -73,10 +73,10 @@ class MinMaxScaler(TransformMixin, BaseEstimator):
         self.scale_ = None
         self.min_ = None
 
-    def fit(self, x: DNDarray) -> "MinMaxScaler":
-        _check_2d_float(x, "MinMaxScaler")
-        self.data_min_ = ht.min(x, axis=0)
-        self.data_max_ = ht.max(x, axis=0)
+    def fit(self, X: DNDarray) -> "MinMaxScaler":
+        _check_2d_float(X, "MinMaxScaler")
+        self.data_min_ = ht.min(X, axis=0)
+        self.data_max_ = ht.max(X, axis=0)
         rng = self.data_max_ - self.data_min_
         safe = ht.where(rng == 0.0, 1.0, rng)
         lo, hi = self.feature_range
@@ -84,15 +84,15 @@ class MinMaxScaler(TransformMixin, BaseEstimator):
         self.min_ = lo - self.data_min_ * self.scale_
         return self
 
-    def transform(self, x: DNDarray) -> DNDarray:
-        _check_2d_float(x, "MinMaxScaler")
-        out = x * self.scale_.astype(x.dtype) + self.min_.astype(x.dtype)
+    def transform(self, X: DNDarray) -> DNDarray:
+        _check_2d_float(X, "MinMaxScaler")
+        out = X * self.scale_.astype(X.dtype) + self.min_.astype(X.dtype)
         if self.clip:
             out = ht.clip(out, self.feature_range[0], self.feature_range[1])
         return out
 
-    def inverse_transform(self, y: DNDarray) -> DNDarray:
-        return (y - self.min_.astype(y.dtype)) / self.scale_.astype(y.dtype)
+    def inverse_transform(self, Y: DNDarray) -> DNDarray:
+        return (Y - self.min_.astype(Y.dtype)) / self.scale_.astype(Y.dtype)
 
 
 class Normalizer(TransformMixin, BaseEstimator):
@@ -104,12 +104,12 @@ class Normalizer(TransformMixin, BaseEstimator):
         self.norm = norm
         self.copy = copy
 
-    def fit(self, x: DNDarray) -> "Normalizer":
+    def fit(self, X: DNDarray) -> "Normalizer":
         return self  # stateless, like the reference
 
-    def transform(self, x: DNDarray) -> DNDarray:
-        _check_2d_float(x, "Normalizer")
-        xv = x.larray
+    def transform(self, X: DNDarray) -> DNDarray:
+        _check_2d_float(X, "Normalizer")
+        xv = X.larray
         if self.norm == "l1":
             n = jnp.sum(jnp.abs(xv), axis=1, keepdims=True)
         elif self.norm == "l2":
@@ -119,7 +119,7 @@ class Normalizer(TransformMixin, BaseEstimator):
         n = jnp.where(n == 0, 1.0, n)
         from ..core._operations import wrap_result
 
-        return wrap_result(xv / n, x, x.split)
+        return wrap_result(xv / n, X, X.split)
 
 
 class MaxAbsScaler(TransformMixin, BaseEstimator):
@@ -130,18 +130,18 @@ class MaxAbsScaler(TransformMixin, BaseEstimator):
         self.max_abs_ = None
         self.scale_ = None
 
-    def fit(self, x: DNDarray) -> "MaxAbsScaler":
-        _check_2d_float(x, "MaxAbsScaler")
-        self.max_abs_ = ht.max(ht.abs(x), axis=0)
+    def fit(self, X: DNDarray) -> "MaxAbsScaler":
+        _check_2d_float(X, "MaxAbsScaler")
+        self.max_abs_ = ht.max(ht.abs(X), axis=0)
         self.scale_ = ht.where(self.max_abs_ == 0.0, 1.0, self.max_abs_)
         return self
 
-    def transform(self, x: DNDarray) -> DNDarray:
-        _check_2d_float(x, "MaxAbsScaler")
-        return x / self.scale_.astype(x.dtype)
+    def transform(self, X: DNDarray) -> DNDarray:
+        _check_2d_float(X, "MaxAbsScaler")
+        return X / self.scale_.astype(X.dtype)
 
-    def inverse_transform(self, y: DNDarray) -> DNDarray:
-        return y * self.scale_.astype(y.dtype)
+    def inverse_transform(self, Y: DNDarray) -> DNDarray:
+        return Y * self.scale_.astype(Y.dtype)
 
 
 class RobustScaler(TransformMixin, BaseEstimator):
@@ -169,29 +169,29 @@ class RobustScaler(TransformMixin, BaseEstimator):
         self.center_ = None
         self.iqr_ = None
 
-    def fit(self, x: DNDarray) -> "RobustScaler":
-        _check_2d_float(x, "RobustScaler")
+    def fit(self, X: DNDarray) -> "RobustScaler":
+        _check_2d_float(X, "RobustScaler")
         if self.with_centering:
-            self.center_ = ht.median(x, axis=0)
+            self.center_ = ht.median(X, axis=0)
         if self.with_scaling:
             lo, hi = self.quantile_range
-            q_lo = ht.percentile(x, lo, axis=0)
-            q_hi = ht.percentile(x, hi, axis=0)
+            q_lo = ht.percentile(X, lo, axis=0)
+            q_hi = ht.percentile(X, hi, axis=0)
             rng = q_hi - q_lo
             self.iqr_ = ht.where(rng == 0.0, 1.0, rng)
         return self
 
-    def transform(self, x: DNDarray) -> DNDarray:
-        _check_2d_float(x, "RobustScaler")
-        out = x
+    def transform(self, X: DNDarray) -> DNDarray:
+        _check_2d_float(X, "RobustScaler")
+        out = X
         if self.with_centering:
             out = out - self.center_.astype(out.dtype)
         if self.with_scaling:
             out = out / self.iqr_.astype(out.dtype)
         return out
 
-    def inverse_transform(self, y: DNDarray) -> DNDarray:
-        out = y
+    def inverse_transform(self, Y: DNDarray) -> DNDarray:
+        out = Y
         if self.with_scaling:
             out = out * self.iqr_.astype(out.dtype)
         if self.with_centering:

@@ -206,8 +206,12 @@ class DCSR_matrix:
     def numpy(self) -> np.ndarray:
         return np.asarray(self.__array.todense())
 
-    def astype(self, dtype) -> "DCSR_matrix":
+    def astype(self, dtype, copy: bool = True) -> "DCSR_matrix":
+        """Cast element type (reference ``dcsr_matrix.py`` astype); with
+        ``copy=False`` a matching dtype returns self."""
         dtype = types.canonical_heat_type(dtype)
+        if not copy and dtype is self.dtype:
+            return self
         new = jsparse.BCOO(
             (self.__array.data.astype(dtype.jax_type()), self.__array.indices),
             shape=self.__gshape,

@@ -46,6 +46,20 @@ class Dataset:
         """Uniform global permutation of the samples (reference ``dataset_shuffle``)."""
         dataset_shuffle(self)
 
+    def Shuffle(self) -> None:
+        """Cross-shard shuffle unless this is a test set (reference
+        ``datatools.py:229`` — there a half-to-neighbour send + local shuffle; under
+        SPMD one global permutation is the equivalent observable)."""
+        if not self.test_set:
+            dataset_shuffle(self)
+
+    def Ishuffle(self) -> None:
+        """Non-blocking shuffle (reference ``datatools.py:237``). XLA dispatch is
+        already asynchronous — the permutation is enqueued and this returns without
+        blocking on device work, which is the reference's contract."""
+        if not self.test_set:
+            dataset_ishuffle(self)
+
 
 class DataLoader:
     """Minibatch iterator over a Dataset or DNDarray (reference ``datatools.py:16``).

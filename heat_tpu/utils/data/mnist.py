@@ -42,3 +42,37 @@ class MNISTDataset:
         if self.transform is not None:
             img = self.transform(img)
         return img, self.httargets[index]
+
+    def _shuffle_together(self) -> None:
+        # one global permutation applied to both arrays, split-preserving
+        # (reference mnist.py:113 shuffles data and targets through the same
+        # dataset_shuffle call; a plain fancy-index would replicate the result)
+        import jax.numpy as jnp
+
+        from ...core.dndarray import DNDarray
+
+        n = int(self.htdata.gshape[0])
+        perm = ht.random.randperm(n)
+        for name in ("htdata", "httargets"):
+            a = getattr(self, name)
+            taken = jnp.take(a.larray, perm.larray, axis=0)
+            setattr(
+                self,
+                name,
+                DNDarray(
+                    a.comm.shard(taken, a.split), a.gshape, a.dtype, a.split,
+                    a.device, a.comm, True,
+                ),
+            )
+
+    def Shuffle(self) -> None:
+        """Cross-shard shuffle of images and labels together unless this is a test
+        set (reference ``mnist.py:113``)."""
+        if not self.test_set:
+            self._shuffle_together()
+
+    def Ishuffle(self) -> None:
+        """Non-blocking shuffle (reference ``mnist.py:121``); XLA dispatch is already
+        asynchronous, so the permutation is enqueued without blocking."""
+        if not self.test_set:
+            self._shuffle_together()

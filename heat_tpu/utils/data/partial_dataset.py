@@ -76,6 +76,43 @@ class PartialH5Dataset:
     def __len__(self) -> int:
         return self.total_size
 
+    def Shuffle(self):
+        """Cross-shard shuffle — not implemented for partial datasets, exactly like
+        the reference (``partial_dataset.py:157``: windows stream from disk in file
+        order; shuffle the source file instead)."""
+        return NotImplementedError
+
+    def Ishuffle(self):
+        """Non-blocking shuffle — not implemented for partial datasets (reference
+        ``partial_dataset.py:166``)."""
+        return NotImplementedError
+
+    def thread_replace_converted_batches(self, window: dict, used_indices: List[int],
+                                         next_start: int) -> int:
+        """Refill consumed rows of a resident ``window`` from the next file range
+        (reference ``partial_dataset.py:188`` — there a background thread swaps
+        ``used_indices`` rows for freshly loaded ones under a condition variable;
+        here the same replacement runs synchronously on the caller's window dict,
+        and the async overlap lives in :meth:`thread_loader`'s prefetch queue).
+
+        Returns the next unread file offset (wraps at the end of the file).
+        """
+        import h5py
+
+        n = len(used_indices)
+        if n == 0:
+            return next_start
+        with h5py.File(self.file, "r") as f:
+            fresh = {}
+            for name in self.dataset_names:
+                head = np.asarray(f[name][next_start : min(next_start + n, self.total_size)])
+                if len(head) < n:  # wrap: finish the tail rows, then restart at 0
+                    head = np.concatenate([head, np.asarray(f[name][: n - len(head)])])
+                fresh[name] = head
+        for name in self.dataset_names:
+            window[name][np.asarray(used_indices[: len(fresh[name])])] = fresh[name]
+        return (next_start + n) % self.total_size
+
     def thread_loader(self, out_queue: "queue.Queue", start: int, stop: int) -> None:
         """Background reader: pushes (name -> chunk) dicts (reference ``:188``)."""
         import h5py

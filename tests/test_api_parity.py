@@ -146,6 +146,77 @@ def test_signature_parameter_parity():
 
 
 @pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference tree not present")
+def test_class_method_parity():
+    """Public methods of every shared class must exist with the reference's
+    parameter names (estimator fit(X)/transform(X), dataset Shuffle/Ishuffle,
+    tiling accessors, ...)."""
+    import inspect
+
+    import heat_tpu as ht
+
+    def class_sigs(path):
+        out = {}
+        try:
+            tree = ast.parse(open(path, encoding="utf-8").read())
+        except SyntaxError:
+            return out
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                methods = {}
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef) and not sub.name.startswith("_"):
+                        a = sub.args
+                        methods[sub.name] = [
+                            x.arg
+                            for x in a.posonlyargs + a.args + a.kwonlyargs
+                            if x.arg not in ("self", "cls")
+                        ]
+                out[node.name] = methods
+        return out
+
+    ref_classes = {}
+    for root, _dirs, files in os.walk(REFERENCE):
+        if "tests" in root:
+            continue
+        for fname in files:
+            if fname.endswith(".py"):
+                for cls, methods in class_sigs(os.path.join(root, fname)).items():
+                    ref_classes.setdefault(cls, methods)
+
+    namespaces = [
+        ht, ht.cluster, ht.classification, ht.naive_bayes, ht.regression,
+        ht.preprocessing, ht.graph, ht.sparse, ht.nn, ht.optim, ht.utils.data,
+        ht.spatial,
+    ]
+    problems, checked = [], 0
+    for cls_name, methods in sorted(ref_classes.items()):
+        target_cls = next(
+            (getattr(ns, cls_name) for ns in namespaces if hasattr(ns, cls_name)), None
+        )
+        if target_cls is None or not inspect.isclass(target_cls):
+            continue
+        for m_name, ref_params in sorted(methods.items()):
+            checked += 1
+            m = getattr(target_cls, m_name, None)
+            if m is None:
+                problems.append(f"{cls_name}.{m_name}: MISSING METHOD")
+                continue
+            if not callable(m):
+                continue  # reference method realised as a property here (or both)
+            try:
+                ours = set(inspect.signature(m).parameters)
+            except (ValueError, TypeError):
+                continue
+            if any(p in ours for p in ("args", "kwargs")):
+                continue
+            lack = [p for p in ref_params if p not in ours]
+            if lack:
+                problems.append(f"{cls_name}.{m_name}: missing {lack}")
+    assert checked > 150, f"sweep looks broken: {checked}"
+    assert not problems, "\n".join(problems)
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference tree not present")
 def test_data_utils_names_importable_flat():
     """The four names VERDICT r2 flagged as missing from the utils.data namespace."""
     from heat_tpu.utils import data
