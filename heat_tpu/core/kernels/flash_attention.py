@@ -49,10 +49,13 @@ _BWD_BK = 512
 _MAX_PAIRS = 8192
 
 
-def _fwd_blocks(dtype, tq: int, tk: int) -> tuple:
+def _fwd_blocks(dtype, tq: int, tk: int, with_bias: bool = False) -> tuple:
     """Largest preferred (bq, bk) that tiles (tq, tk) evenly, else the smallest
-    preference (whose divisibility _fits re-checks and may reject)."""
-    prefs = _FWD_BLOCK_PREFS.get(jnp.dtype(dtype).itemsize, ((512, 512),))
+    preference (whose divisibility _fits re-checks and may reject). A streamed
+    bias adds a double-buffered f32 (bq, bk) block, so biased bf16 runs use the
+    smaller f32 tile preferences."""
+    size = 4 if with_bias else jnp.dtype(dtype).itemsize
+    prefs = _FWD_BLOCK_PREFS.get(size, ((512, 512),))
     for bq, bk in prefs:
         if tq % bq == 0 and tk % bk == 0:
             return bq, bk
@@ -76,8 +79,8 @@ def flash_attention_reference(q, k, v, causal: bool = False, scale=None):
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def _kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-            acc_ref, m_ref, l_ref, *, scale: float, bq: int, bk: int):
+def _kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, *refs,
+            scale: float, bq: int, bk: int, has_bias: bool = False):
     """One (q-block, k-block) tile of the online-softmax recurrence.
 
     The grid is the *flattened list of contributing (i, j) pairs* (splash-style):
@@ -94,6 +97,11 @@ def _kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     the output accumulator are f32.
     """
     import jax.experimental.pallas as pl
+
+    if has_bias:
+        bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
 
     p = pl.program_id(1)
     d = q_ref.shape[2]
@@ -113,13 +121,19 @@ def _kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lax.dot_general(q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         * scale
     )  # (bq, bk) f32
+    if has_bias:
+        s = s + bias_ref[...]
 
     def _update(s):
         m = m_ref[...]
         m_blk = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m, m_blk)
-        p_tile = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
+        # a bias can mask a whole row of the block (all -inf): keep the exps
+        # finite — the row's l stays 0 and its output finalizes to 0 like the
+        # dense path
+        m_safe = jnp.maximum(m_new, _NEG_INF / 2) if has_bias else m_new
+        p_tile = jnp.exp(s - m_safe)
+        corr = jnp.exp(m - m_safe)
         l_ref[...] = l_ref[...] * corr + jnp.sum(p_tile, axis=1, keepdims=True)
         # probabilities ride the MXU in the value dtype (standard flash practice;
         # p ∈ [0,1] so the bf16 round-off is bounded), accumulation stays f32
@@ -146,8 +160,9 @@ def _kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = l_ref[...]
         o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        # log-sum-exp residual for the backward pass: L = m + log(l)
-        lse_ref[0] = m_ref[...] + jnp.log(jnp.maximum(l, 1e-30))
+        # log-sum-exp residual for the backward pass: L = m + log(l); the clamp
+        # keeps fully-masked rows finite so the backward's exp(s - L) is 0, not NaN
+        lse_ref[0] = jnp.maximum(m_ref[...], _NEG_INF / 2) + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _pair_schedule(nq: int, nk: int, bq: int, bk: int, causal: bool):
@@ -177,7 +192,7 @@ def _pair_schedule(nq: int, nk: int, bq: int, bk: int, causal: bool):
     jax.jit, static_argnames=("causal", "scale", "bq", "bk", "interpret")
 )
 def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
-                  interpret: bool = False):
+                  interpret: bool = False, bias=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -188,18 +203,28 @@ def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
         qr = q.reshape(bh, tq, d)
         kr = k.reshape(bh, tk, d)
         vr = v.reshape(bh, tk, d)
+        has_bias = bias is not None
 
         im, jm, flags = _pair_schedule(tq // bq, tk // bk, bq, bk, causal)
         npairs = len(im)
 
+        in_specs = [
+            pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
+            pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
+            pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
+        ]
+        inputs = [qr, kr, vr]
+        if has_bias:
+            # (Tq, Tk) additive bias, broadcast over batch/heads: one (bq, bk)
+            # block streams per pair, like k/v
+            in_specs.append(
+                pl.BlockSpec((bq, bk), lambda b, p, im, jm, fl: (im[p], jm[p]))
+            )
+            inputs.append(bias.astype(jnp.float32))
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(bh, npairs),
-            in_specs=[
-                pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
-                pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
-                pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
                 pl.BlockSpec((1, bq, 1), lambda b, p, im, jm, fl: (b, im[p], 0)),
@@ -211,25 +236,30 @@ def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
             ],
         )
         out, lse = pl.pallas_call(
-            functools.partial(_kernel, scale=scale, bq=bq, bk=bk),
+            functools.partial(_kernel, scale=scale, bq=bq, bk=bk, has_bias=has_bias),
             grid_spec=grid_spec,
             out_shape=[
                 jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
                 jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
             ],
             interpret=interpret,
-        )(jnp.asarray(im), jnp.asarray(jm), jnp.asarray(flags), qr, kr, vr)
+        )(jnp.asarray(im), jnp.asarray(jm), jnp.asarray(flags), *inputs)
         return out.reshape(*batch, tq, d), lse.reshape(*batch, tq)
 
 
 def _dq_kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-               dd_ref, dq_ref, acc_ref, *, scale: float, bq: int, bk: int):
+               dd_ref, *refs, scale: float, bq: int, bk: int, has_bias: bool = False):
     """dq_i = Σ_j dS_ij · k_j · scale with dS = P ∘ (dO·Vᵀ − D).
 
     Streams k/v blocks over the same flattened (i, j) pair grid as the forward;
     the dq accumulator lives in VMEM scratch across each row sweep, so only
     O(bq·bk) is resident regardless of T."""
     import jax.experimental.pallas as pl
+
+    if has_bias:
+        bias_ref, dq_ref, acc_ref = refs
+    else:
+        dq_ref, acc_ref = refs
 
     p = pl.program_id(1)
     flags = flags_ref[p]
@@ -249,6 +279,8 @@ def _dq_kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         lax.dot_general(q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         * scale
     )
+    if has_bias:
+        s = s + bias_ref[...]
 
     def _update(s):
         p_tile = jnp.exp(s - lse)  # exact probabilities via the saved LSE
@@ -274,13 +306,18 @@ def _dq_kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _dkv_kernel(jm_ref, im_ref, flags_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                dd_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
-                scale: float, bq: int, bk: int):
+                dd_ref, *refs, scale: float, bq: int, bk: int,
+                has_bias: bool = False):
     """dk_j = Σ_i dSᵀ_ij · q_i · scale,  dv_j = Σ_i Pᵀ_ij · dO_i.
 
     Streams q/dO/LSE blocks over a kv-major flattened (j, i) pair grid with the
     dk/dv accumulators in VMEM scratch — no full-panel residency."""
     import jax.experimental.pallas as pl
+
+    if has_bias:
+        bias_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = refs
+    else:
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = refs
 
     p = pl.program_id(1)
     flags = flags_ref[p]
@@ -302,6 +339,8 @@ def _dkv_kernel(jm_ref, im_ref, flags_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         lax.dot_general(qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         * scale
     )
+    if has_bias:
+        s = s + bias_ref[...]
 
     def _update(s):
         p_tile = jnp.exp(s - lse)
@@ -365,7 +404,7 @@ def _pair_schedule_kv(nq: int, nk: int, bq: int, bk: int, causal: bool):
     jax.jit, static_argnames=("causal", "scale", "bq", "bk", "interpret")
 )
 def _flash_bwd_pallas(q, k, v, o, do, lse, causal: bool, scale: float, bq: int,
-                      bk: int, interpret: bool = False):
+                      bk: int, interpret: bool = False, bias=None):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -384,40 +423,57 @@ def _flash_bwd_pallas(q, k, v, o, do, lse, causal: bool, scale: float, bq: int,
             axis=-1, keepdims=True,
         )
 
+        has_bias = bias is not None
+        bias_f32 = bias.astype(jnp.float32) if has_bias else None
+
         im, jm, flags = _pair_schedule(tq // bq, tk // bk, bq, bk, causal)
+        dq_in_specs = [
+            pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
+            pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
+            pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
+            pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, p, im, jm, fl: (b, im[p], 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, p, im, jm, fl: (b, im[p], 0)),
+        ]
+        dq_inputs = [qr, kr, vr, dor, lser, dd]
+        if has_bias:
+            dq_in_specs.append(
+                pl.BlockSpec((bq, bk), lambda b, p, im, jm, fl: (im[p], jm[p]))
+            )
+            dq_inputs.append(bias_f32)
         dq_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(bh, len(im)),
-            in_specs=[
-                pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
-                pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
-                pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
-                pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
-                pl.BlockSpec((1, bq, 1), lambda b, p, im, jm, fl: (b, im[p], 0)),
-                pl.BlockSpec((1, bq, 1), lambda b, p, im, jm, fl: (b, im[p], 0)),
-            ],
+            in_specs=dq_in_specs,
             out_specs=pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
             scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         )
         dq = pl.pallas_call(
-            functools.partial(_dq_kernel, scale=scale, bq=bq, bk=bk),
+            functools.partial(_dq_kernel, scale=scale, bq=bq, bk=bk, has_bias=has_bias),
             grid_spec=dq_spec,
             out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
             interpret=interpret,
-        )(jnp.asarray(im), jnp.asarray(jm), jnp.asarray(flags), qr, kr, vr, dor, lser, dd)
+        )(jnp.asarray(im), jnp.asarray(jm), jnp.asarray(flags), *dq_inputs)
 
         jm2, im2, flags2 = _pair_schedule_kv(tq // bq, tk // bk, bq, bk, causal)
+        dkv_in_specs = [
+            pl.BlockSpec((1, bq, d), lambda b, p, jm, im, fl: (b, im[p], 0)),
+            pl.BlockSpec((1, bk, d), lambda b, p, jm, im, fl: (b, jm[p], 0)),
+            pl.BlockSpec((1, bk, d), lambda b, p, jm, im, fl: (b, jm[p], 0)),
+            pl.BlockSpec((1, bq, d), lambda b, p, jm, im, fl: (b, im[p], 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, p, jm, im, fl: (b, im[p], 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, p, jm, im, fl: (b, im[p], 0)),
+        ]
+        dkv_inputs = [qr, kr, vr, dor, lser, dd]
+        if has_bias:
+            dkv_in_specs.append(
+                pl.BlockSpec((bq, bk), lambda b, p, jm, im, fl: (im[p], jm[p]))
+            )
+            dkv_inputs.append(bias_f32)
         dkv_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(bh, len(jm2)),
-            in_specs=[
-                pl.BlockSpec((1, bq, d), lambda b, p, jm, im, fl: (b, im[p], 0)),
-                pl.BlockSpec((1, bk, d), lambda b, p, jm, im, fl: (b, jm[p], 0)),
-                pl.BlockSpec((1, bk, d), lambda b, p, jm, im, fl: (b, jm[p], 0)),
-                pl.BlockSpec((1, bq, d), lambda b, p, jm, im, fl: (b, im[p], 0)),
-                pl.BlockSpec((1, bq, 1), lambda b, p, jm, im, fl: (b, im[p], 0)),
-                pl.BlockSpec((1, bq, 1), lambda b, p, jm, im, fl: (b, im[p], 0)),
-            ],
+            in_specs=dkv_in_specs,
             out_specs=[
                 pl.BlockSpec((1, bk, d), lambda b, p, jm, im, fl: (b, jm[p], 0)),
                 pl.BlockSpec((1, bk, d), lambda b, p, jm, im, fl: (b, jm[p], 0)),
@@ -428,14 +484,14 @@ def _flash_bwd_pallas(q, k, v, o, do, lse, causal: bool, scale: float, bq: int,
             ],
         )
         dk, dv = pl.pallas_call(
-            functools.partial(_dkv_kernel, scale=scale, bq=bq, bk=bk),
+            functools.partial(_dkv_kernel, scale=scale, bq=bq, bk=bk, has_bias=has_bias),
             grid_spec=dkv_spec,
             out_shape=[
                 jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
                 jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
             ],
             interpret=interpret,
-        )(jnp.asarray(jm2), jnp.asarray(im2), jnp.asarray(flags2), qr, kr, vr, dor, lser, dd)
+        )(jnp.asarray(jm2), jnp.asarray(im2), jnp.asarray(flags2), *dkv_inputs)
         return (
             dq.reshape(*batch, tq, d),
             dk.reshape(*batch, tk, d),
@@ -443,7 +499,7 @@ def _flash_bwd_pallas(q, k, v, o, do, lse, causal: bool, scale: float, bq: int,
         )
 
 
-def _fits(q, k, bq: int, bk: int) -> bool:
+def _fits(q, k, bq: int, bk: int, with_bias: bool = False) -> bool:
     """VMEM gate: forward and backward all stream blocks through the grid now, so
     residency is O(bq·bk) regardless of T — the gate only enforces even tiling
     and a sane per-step footprint."""
@@ -460,51 +516,93 @@ def _fits(q, k, bq: int, bk: int) -> bool:
     if (tq // _BWD_BQ) * (tk // _BWD_BK) > _MAX_PAIRS:
         return False
     itemsize = jnp.dtype(q.dtype).itemsize
-    # per-step residency: s + p tiles (f32), accumulator, double-buffered blocks
-    fwd = 8 * bq * bk + 4 * bq * d + 2 * (bq + 2 * bk) * d * itemsize * 2
+    # per-step residency: s + p tiles (f32), accumulator, double-buffered blocks,
+    # plus a double-buffered f32 bias block when a mask streams through
+    bias_fwd = 8 * bq * bk if with_bias else 0
+    bias_bwd = 8 * _BWD_BQ * _BWD_BK if with_bias else 0
+    fwd = 8 * bq * bk + 4 * bq * d + 2 * (bq + 2 * bk) * d * itemsize * 2 + bias_fwd
     bwd = 8 * _BWD_BQ * _BWD_BK + 8 * _BWD_BK * d \
-        + 2 * (_BWD_BQ + 2 * _BWD_BK) * d * itemsize * 2
+        + 2 * (_BWD_BQ + 2 * _BWD_BK) * d * itemsize * 2 + bias_bwd
     return max(fwd, bwd) <= 12 * 2**20
 
 
+def _as_bias(mask):
+    """Normalize a (Tq, Tk) mask to an additive f32 bias: boolean True = attend
+    (the dense-path convention in nn/attention.py), floats pass through."""
+    if mask is None:
+        return None
+    if mask.dtype == jnp.bool_:
+        return jnp.where(mask, jnp.float32(0), jnp.float32(_NEG_INF))
+    return mask.astype(jnp.float32)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal: bool = False, scale=None):
+def flash_attention(q, k, v, causal: bool = False, scale=None, mask=None):
     """Exact attention with the flash (streaming-VMEM) forward on TPU.
 
     q: (..., Tq, D), k/v: (..., Tk, D); Tq/Tk must be multiples of the block
     sizes (callers fall back to the XLA path otherwise via :func:`use_flash`).
-    The backward is the flash backward (two Pallas kernels over the saved
-    (O, LSE) residuals). All three kernels stream blocks through a flattened
-    pair grid, so VMEM residency is O(block²) regardless of T — arbitrarily
-    long sequences fit, and the (T, T) matrix never exists in HBM.
+    ``mask`` is an optional exact-shape (Tq, Tk) boolean (True = attend) or
+    additive float bias, shared across batch/heads and streamed blockwise like
+    k/v. Float biases are NOT differentiated on this path (grad raises; use the
+    XLA path for a learned bias). The backward is the flash backward (two Pallas
+    kernels over the saved (O, LSE) residuals). All three kernels stream blocks
+    through a flattened pair grid, so VMEM residency is O(block²) regardless of
+    T — arbitrarily long sequences fit, and the (T, T) matrix never exists in
+    HBM.
     """
     s = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    blocks = _fwd_blocks(q.dtype, q.shape[-2], k.shape[-2])
-    out, _ = _flash_pallas(q, k, v, causal, float(s), *blocks)
+    bias = _as_bias(mask)
+    blocks = _fwd_blocks(q.dtype, q.shape[-2], k.shape[-2], with_bias=bias is not None)
+    out, _ = _flash_pallas(q, k, v, causal, float(s), *blocks, bias=bias)
     return out
 
 
-def _fwd(q, k, v, causal, scale):
+def _fwd(q, k, v, causal, scale, mask):
     s = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    blocks = _fwd_blocks(q.dtype, q.shape[-2], k.shape[-2])
-    out, lse = _flash_pallas(q, k, v, causal, float(s), *blocks)
-    return out, (q, k, v, out, lse)
+    bias = _as_bias(mask)
+    blocks = _fwd_blocks(q.dtype, q.shape[-2], k.shape[-2], with_bias=bias is not None)
+    out, lse = _flash_pallas(q, k, v, causal, float(s), *blocks, bias=bias)
+    return out, (q, k, v, out, lse, mask)
 
 
 def _bwd(causal, scale, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse, mask = res
+    if mask is not None and mask.dtype != jnp.bool_:
+        # a float bias has a real gradient (Σ_{b,h} dS) that this backward does not
+        # compute — fail loudly rather than silently training the bias to nothing.
+        # use_flash only routes BOOL masks here; differentiable biases belong on
+        # the XLA path, which differentiates scores + bias normally.
+        raise NotImplementedError(
+            "gradient through a float attention bias is not implemented on the "
+            "flash path; boolean masks are gradient-free and fine — use the XLA "
+            "attention path for a learned additive bias"
+        )
     s = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
-    return _flash_bwd_pallas(q, k, v, out, g, lse, causal, float(s), _BWD_BQ, _BWD_BK)
+    dq, dk, dv = _flash_bwd_pallas(
+        q, k, v, out, g, lse, causal, float(s), _BWD_BQ, _BWD_BK, bias=_as_bias(mask)
+    )
+    # boolean masks have no tangent space; the zero cotangent is exact
+    dmask = None if mask is None else jnp.zeros_like(mask, dtype=jnp.float32)
+    return dq, dk, dv, dmask
 
 
 flash_attention.defvjp(_fwd, _bwd)
 
 
 def use_flash(q, k, v, mask, scale=None, interpret: bool = False) -> bool:
-    """True when the Pallas forward applies: TPU backend, no explicit mask, a
-    static (or default) scale, a Mosaic-supported dtype, and shapes that fit the
-    VMEM budget/tiling."""
-    if mask is not None:
+    """True when the Pallas forward applies: TPU backend, a static (or default)
+    scale, a Mosaic-supported dtype, shapes that fit the VMEM budget/tiling, and
+    a mask that is either absent or an exact-shape (Tq, Tk) BOOLEAN shared across
+    batch/heads. Per-batch masks (e.g. (B, 1, 1, Tk) padding forms) and float
+    biases take the XLA path — the former aren't streamable as one 2-D block,
+    the latter have a bias gradient only the XLA path computes."""
+    with_bias = mask is not None
+    if with_bias and (
+        mask.ndim != 2
+        or mask.shape != (q.shape[-2], k.shape[-2])
+        or mask.dtype != jnp.bool_
+    ):
         return False
     if scale is not None and not isinstance(scale, (int, float)):
         # a traced scale can't become the kernel's static parameter; XLA path handles it
@@ -516,4 +614,4 @@ def use_flash(q, k, v, mask, scale=None, interpret: bool = False) -> bool:
         return False
     if not interpret and jax.default_backend() != "tpu":
         return False
-    return _fits(q, k, *_fwd_blocks(q.dtype, q.shape[-2], k.shape[-2]))
+    return _fits(q, k, *_fwd_blocks(q.dtype, q.shape[-2], k.shape[-2], with_bias))

@@ -62,7 +62,7 @@ def _dense_attention(q, k, v, mask=None, is_causal=False, scale=None):
     from ..core.kernels.flash_attention import flash_attention, use_flash
 
     if use_flash(q, k, v, mask, scale):
-        return flash_attention(q, k, v, is_causal, scale)
+        return flash_attention(q, k, v, is_causal, scale, mask)
     d = q.shape[-1]
     s = (1.0 / math.sqrt(d)) if scale is None else scale
     scores = jnp.einsum(
