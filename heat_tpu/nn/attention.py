@@ -265,13 +265,31 @@ class MultiheadAttention(Module):
         return params
 
     def apply(self, params, x, *, key=None, train=False, attn_mask=None,
-              is_causal: bool = False):
+              is_causal: bool = False, key_padding_mask=None):
         if isinstance(x, tuple):
             q_in, k_in, v_in = x
         else:
             q_in = k_in = v_in = x
         unwrap = lambda t: t.larray if isinstance(t, DNDarray) else t
         attn_mask = unwrap(attn_mask) if attn_mask is not None else None
+        if attn_mask is not None and attn_mask.dtype == jnp.bool_:
+            # torch.nn.MultiheadAttention convention: True = NOT allowed to attend
+            # — the INVERSE of torch sdpa's (and our sdpa path's) True = attend.
+            # Float masks are additive in both conventions.
+            attn_mask = ~attn_mask
+        if key_padding_mask is not None:
+            # (B, S): bool True = ignore that key for every query; floats are an
+            # additive bias (both torch conventions); merged additively so it
+            # broadcasts over heads and queries
+            from ..core.kernels.flash_attention import _as_bias
+
+            kpm = unwrap(key_padding_mask)
+            pad = (
+                jnp.where(kpm, jnp.float32(_NEG_INF), jnp.float32(0))
+                if kpm.dtype == jnp.bool_
+                else kpm.astype(jnp.float32)
+            )[:, None, None, :]  # (B, 1, 1, S)
+            attn_mask = pad if attn_mask is None else _as_bias(attn_mask) + pad
         proto = q_in if isinstance(q_in, DNDarray) else None
         seq_axis_in = 1 if self.batch_first else 0
         seq_split = (
@@ -326,11 +344,13 @@ class MultiheadAttention(Module):
             return wrap_result(o, proto, keep)
         return o
 
-    def __call__(self, query, key=None, value=None, attn_mask=None,
-                 is_causal: bool = False, need_weights: bool = False):
+    def __call__(self, query, key=None, value=None, key_padding_mask=None,
+                 need_weights: bool = False, attn_mask=None,
+                 average_attn_weights: bool = True, is_causal: bool = False):
         """torch call convention: ``mha(q, k, v)`` returns ``(output, None)`` when
         ``need_weights=False`` (weights are never materialized — blockwise kernels
-        don't form the T×T matrix)."""
+        don't form the T×T matrix). ``key_padding_mask`` is (B, S) with True =
+        ignore that key, like torch."""
         if need_weights:
             raise NotImplementedError(
                 "need_weights=True would materialize the T×T attention matrix; "
@@ -341,5 +361,8 @@ class MultiheadAttention(Module):
         if value is None:
             value = key
         x = query if (key is query and value is query) else (query, key, value)
-        out = self.apply(self.params, x, attn_mask=attn_mask, is_causal=is_causal)
+        out = self.apply(
+            self.params, x, attn_mask=attn_mask, is_causal=is_causal,
+            key_padding_mask=key_padding_mask,
+        )
         return out, None

@@ -89,6 +89,69 @@ class TestDenseSDPA:
         )
         np.testing.assert_allclose(got.numpy(), np.asarray(want), rtol=1e-5, atol=1e-6)
 
+    def test_mha_bool_mask_torch_convention(self):
+        """torch.nn.MultiheadAttention bool attn_mask means True = NOT allowed —
+        the inverse of sdpa's convention; ours must match torch's module."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(21)
+        E, H, T, B = 16, 4, 6, 2
+        x = rng.standard_normal((B, T, E)).astype(np.float32)
+        tm = torch.nn.MultiheadAttention(E, H, batch_first=True, bias=True)
+        hm = ht.nn.MultiheadAttention(E, H, batch_first=True, bias=True)
+        sd = tm.state_dict()
+        hm.params["in_proj_weight"] = jnp.asarray(sd["in_proj_weight"].numpy())
+        hm.params["in_proj_bias"] = jnp.asarray(sd["in_proj_bias"].numpy())
+        hm.params["out_proj_weight"] = jnp.asarray(sd["out_proj.weight"].numpy())
+        hm.params["out_proj_bias"] = jnp.asarray(sd["out_proj.bias"].numpy())
+        not_allowed = np.triu(np.ones((T, T), bool), k=1)
+        t_out, _ = tm(
+            torch.tensor(x), torch.tensor(x), torch.tensor(x),
+            attn_mask=torch.tensor(not_allowed), need_weights=False,
+        )
+        h_out, _ = hm(ht.array(x), attn_mask=jnp.asarray(not_allowed))
+        np.testing.assert_allclose(
+            h_out.numpy(), t_out.detach().numpy(), rtol=1e-5, atol=1e-5
+        )
+
+    def test_mha_key_padding_mask_torch_parity(self):
+        """torch key_padding_mask: (B, S) True = ignore that key for all queries."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(22)
+        E, H, T, B = 16, 4, 6, 2
+        x = rng.standard_normal((B, T, E)).astype(np.float32)
+        tm = torch.nn.MultiheadAttention(E, H, batch_first=True, bias=True)
+        hm = ht.nn.MultiheadAttention(E, H, batch_first=True, bias=True)
+        sd = tm.state_dict()
+        hm.params["in_proj_weight"] = jnp.asarray(sd["in_proj_weight"].numpy())
+        hm.params["in_proj_bias"] = jnp.asarray(sd["in_proj_bias"].numpy())
+        hm.params["out_proj_weight"] = jnp.asarray(sd["out_proj.weight"].numpy())
+        hm.params["out_proj_bias"] = jnp.asarray(sd["out_proj.bias"].numpy())
+        kpm = np.zeros((B, T), bool)
+        kpm[0, 4:] = True  # first example: last two keys are padding
+        kpm[1, 5:] = True
+        t_out, _ = tm(
+            torch.tensor(x), torch.tensor(x), torch.tensor(x),
+            key_padding_mask=torch.tensor(kpm), need_weights=False,
+        )
+        h_out, _ = hm(ht.array(x), key_padding_mask=jnp.asarray(kpm))
+        np.testing.assert_allclose(
+            h_out.numpy(), t_out.detach().numpy(), rtol=1e-5, atol=1e-5
+        )
+        # combined with a bool attn_mask (both in torch conventions)
+        not_allowed = np.triu(np.ones((T, T), bool), k=1)
+        t_out2, _ = tm(
+            torch.tensor(x), torch.tensor(x), torch.tensor(x),
+            attn_mask=torch.tensor(not_allowed),
+            key_padding_mask=torch.tensor(kpm), need_weights=False,
+        )
+        h_out2, _ = hm(
+            ht.array(x), attn_mask=jnp.asarray(not_allowed),
+            key_padding_mask=jnp.asarray(kpm),
+        )
+        np.testing.assert_allclose(
+            h_out2.numpy(), t_out2.detach().numpy(), rtol=1e-5, atol=1e-5
+        )
+
     def test_torch_sdpa_parity(self):
         torch = pytest.importorskip("torch")
         rng = np.random.default_rng(3)
