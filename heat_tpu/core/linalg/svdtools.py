@@ -292,7 +292,16 @@ def _truncate_stacked(
     ``(U_trunc, sigma_trunc, err²_dropped)``."""
     u, s, _ = guarded_svd(stacked)
     noiselevel = 1e-14 if stacked.dtype == jnp.float64 else 1e-7
-    s_all = np.asarray(s)  # the level's single host sync
+    # the level's single host sync; under multiple controllers the blocks live on
+    # other hosts too, so the (tiny) singular-value matrix is allgathered so every
+    # controller makes identical truncation decisions (reference allgathers the
+    # local rank dims the same way, svdtools.py:349)
+    if isinstance(s, jax.Array) and not s.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        s_all = np.asarray(multihost_utils.process_allgather(s, tiled=True))
+    else:
+        s_all = np.asarray(s)
 
     results: List[Tuple[jax.Array, jax.Array, float]] = []
     for node_id in range(stacked.shape[0]):

@@ -95,6 +95,25 @@ def main() -> None:
     np.testing.assert_allclose(r.numpy(), global_ref)
     assert float((r - a).abs().max().item()) == 0.0
 
+    # --- the north-star workload under real multi-process SPMD ----------------
+    # low-rank matrix assembled from per-process column chunks; every controller
+    # must recover the same rank-3 factorization
+    rank, m_rows = 3, 12
+    rng = np.random.RandomState(7)  # identical on every process
+    u_true = rng.randn(m_rows, rank).astype(np.float32)
+    v_true = rng.randn(rank, nprocs * 8).astype(np.float32)
+    full = u_true @ v_true
+    local_cols = full[:, pid * 8 : (pid + 1) * 8]
+    A = ht.array(np.ascontiguousarray(local_cols), is_split=1)
+    assert tuple(A.gshape) == full.shape
+    U, sig, V, err = ht.linalg.hsvd_rank(A, rank, compute_sv=True)
+    recon = U.numpy() @ np.diag(sig.numpy()) @ V.numpy().T
+    np.testing.assert_allclose(recon, full, atol=5e-3)
+    q_f, r_f = ht.linalg.qr(ht.array(full[:, : m_rows - 2], split=0))
+    np.testing.assert_allclose(
+        q_f.numpy() @ r_f.numpy(), full[:, : m_rows - 2], atol=1e-4
+    )
+
     print(f"WORKER_OK {pid}", flush=True)
 
 
