@@ -44,6 +44,9 @@ from ..core.dndarray import DNDarray
 __all__ = [
     "scaled_dot_product_attention",
     "ring_attention",
+    "ring_attention_zigzag",
+    "zigzag_order",
+    "zigzag_inverse",
     "ulysses_attention",
     "MultiheadAttention",
 ]
@@ -127,6 +130,27 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return _dense_attention(query, k, v, m, is_causal, scale)
 
 
+def _online_attend(q_blk, q_pos, o, m, l, k_blk, v_blk, k_pos, s, masked: bool):
+    """One online-softmax block merge shared by the ring variants: returns the
+    updated (o, m, l) accumulator after q_blk attends k_blk/v_blk, optionally
+    causal-masked by the global positions."""
+    scores = jnp.einsum(
+        "...qd,...kd->...qk", q_blk, k_blk, preferred_element_type=jnp.float32
+    ) * jnp.float32(s)
+    if masked:
+        scores = jnp.where(q_pos[:, None] >= k_pos[None, :], scores, _NEG_INF)
+    m_blk = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+    corr = jnp.exp(m - m_safe)
+    pij = jnp.exp(scores - m_safe[..., None])
+    l_new = l * corr + jnp.sum(pij, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", pij, v_blk, preferred_element_type=jnp.float32
+    )
+    return o_new, m_new, l_new
+
+
 def ring_attention(q, k, v, axis_name: str, is_causal: bool = False,
                    scale: Optional[float] = None):
     """Ring attention over sequence-sharded chunks — call inside ``shard_map``.
@@ -153,22 +177,8 @@ def ring_attention(q, k, v, axis_name: str, is_causal: bool = False,
     perm = [(i, (i - 1) % p) for i in range(p)]  # after s steps, device i holds chunk (i+s) % p
 
     def attend(o, m, l, k_c, v_c, src):
-        scores = jnp.einsum(
-            "...qd,...kd->...qk", q, k_c, preferred_element_type=jnp.float32
-        ) * jnp.float32(s)
-        if is_causal:
-            k_pos = src * tk + jnp.arange(tk)
-            scores = jnp.where(q_pos[:, None] >= k_pos[None, :], scores, _NEG_INF)
-        m_blk = jnp.max(scores, axis=-1)
-        m_new = jnp.maximum(m, m_blk)
-        m_safe = jnp.maximum(m_new, _NEG_INF / 2)
-        corr = jnp.exp(m - m_safe)
-        pij = jnp.exp(scores - m_safe[..., None])
-        l_new = l * corr + jnp.sum(pij, axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum(
-            "...qk,...kd->...qd", pij, v_c, preferred_element_type=jnp.float32
-        )
-        return o_new, m_new, l_new
+        k_pos = src * tk + jnp.arange(tk)
+        return _online_attend(q, q_pos, o, m, l, k_c, v_c, k_pos, s, is_causal)
 
     def step(carry, step_idx):
         k_c, v_c, o, m, l = carry
@@ -208,6 +218,117 @@ def _ring_sharded(q, k, v, comm, is_causal=False, scale=None):
         out_specs=spec,
     )
     return fn(q, k, v)
+
+
+def zigzag_order(t: int, p: int) -> np.ndarray:
+    """Sequence permutation for the zigzag causal layout: the sequence is cut into
+    ``2p`` chunks and device ``i`` holds chunks ``(i, 2p-1-i)``. Apply with
+    ``x[..., zigzag_order(T, p), :]`` before :func:`ring_attention_zigzag`; invert
+    with :func:`zigzag_inverse`."""
+    if t % (2 * p):
+        raise ValueError(
+            f"zigzag layout needs the sequence length divisible by 2*p, got t={t}, p={p}"
+        )
+    c = t // (2 * p)
+    order = []
+    for i in range(p):
+        order.extend(range(i * c, (i + 1) * c))
+        order.extend(range((2 * p - 1 - i) * c, (2 * p - i) * c))
+    return np.asarray(order, dtype=np.int32)
+
+
+def zigzag_inverse(t: int, p: int) -> np.ndarray:
+    """Inverse permutation of :func:`zigzag_order`."""
+    order = zigzag_order(t, p)
+    inv = np.empty_like(order)
+    inv[order] = np.arange(t, dtype=np.int32)
+    return inv
+
+
+def ring_attention_zigzag(q, k, v, axis_name: str, scale: Optional[float] = None):
+    """Load-balanced CAUSAL ring attention — call inside ``shard_map`` with inputs
+    in the zigzag layout (:func:`zigzag_order`).
+
+    The plain causal ring wastes half its FLOPs: in SPMD lockstep every device
+    executes every step, but device ``i`` only *needs* the k/v chunks ``≤ i`` —
+    the rest are fully masked compute. With the zigzag assignment (device ``i``
+    holds sequence chunks ``i`` and ``2p-1-i``) every step has exactly one
+    always-needed half-product (high queries × low keys) and one
+    predicate-selected half-product, so per-device work is ``T²/2p²`` per step —
+    half the plain ring — and uniform across devices. This is the standard
+    long-context balance trick (e.g. llama3-style context parallelism).
+
+    q/k/v: local (..., 2c, D) chunks where the first ``c`` rows are the device's
+    LOW chunk and the last ``c`` its HIGH chunk. Output is in the same layout.
+    """
+    p = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    two_c = q.shape[-2]
+    c = two_c // 2
+    d = q.shape[-1]
+    s = (1.0 / math.sqrt(d)) if scale is None else scale
+
+    q_lo, q_hi = q[..., :c, :], q[..., c:, :]
+    # global chunk ids: lo = my, hi = 2p-1-my; positions inside a chunk are local
+    lo_pos = my * c + jnp.arange(c)
+
+    def hi_pos_of(dev):
+        return (2 * p - 1 - dev) * c + jnp.arange(c)
+
+    def attend_block(q_blk, q_positions, o, m, l, k_blk, v_blk, k_positions,
+                     masked: bool):
+        return _online_attend(
+            q_blk, q_positions, o, m, l, k_blk, v_blk, k_positions, s, masked
+        )
+
+    zero = jnp.sum(q_lo.astype(jnp.float32) * 0, axis=-1)
+    acc_lo = (jnp.zeros_like(q_lo, jnp.float32), zero + _NEG_INF, zero)
+    acc_hi = (jnp.zeros_like(q_hi, jnp.float32), zero + _NEG_INF, zero)
+    perm = [(i, (i - 1) % p) for i in range(p)]
+
+    # step 0 (self): lo×lo and hi×hi are diagonal blocks (masked); hi×lo is full
+    k_lo, k_hi = k[..., :c, :], k[..., c:, :]
+    v_lo, v_hi = v[..., :c, :], v[..., c:, :]
+    acc_lo = attend_block(q_lo, lo_pos, *acc_lo, k_lo, v_lo, lo_pos, True)
+    acc_hi = attend_block(q_hi, hi_pos_of(my), *acc_hi, k_hi, v_hi, hi_pos_of(my), True)
+    acc_hi = attend_block(q_hi, hi_pos_of(my), *acc_hi, k_lo, v_lo, lo_pos, False)
+
+    def step(carry, step_idx):
+        kc, vc, acc_lo, acc_hi = carry
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        src = (my + step_idx) % p  # device whose pair we now hold
+        k_lo, k_hi = kc[..., :c, :], kc[..., c:, :]
+        v_lo, v_hi = vc[..., :c, :], vc[..., c:, :]
+        # hi queries × src's LOW keys: always needed (2p-1-my > src for src != my)
+        acc_hi = attend_block(q_hi, hi_pos_of(my), *acc_hi, k_lo, v_lo,
+                              src * c + jnp.arange(c), False)
+        # the predicate-selected half: LOW q × src's low k (src < my), else
+        # HIGH q × src's high k (src > my) — both full blocks, same shapes
+        pred = src < my
+        q_sel = jnp.where(pred, q_lo, q_hi)
+        k_sel = jnp.where(pred, k_lo, k_hi)
+        v_sel = jnp.where(pred, v_lo, v_hi)
+        o_sel, m_sel, l_sel = (
+            jnp.where(pred, acc_lo[0], acc_hi[0]),
+            jnp.where(pred, acc_lo[1], acc_hi[1]),
+            jnp.where(pred, acc_lo[2], acc_hi[2]),
+        )
+        upd = attend_block(
+            q_sel, jnp.zeros(c, jnp.int32), o_sel, m_sel, l_sel,
+            k_sel, v_sel, jnp.zeros(c, jnp.int32), False,
+        )
+        acc_lo = tuple(jnp.where(pred, u, a) for u, a in zip(upd, acc_lo))
+        acc_hi = tuple(jnp.where(pred, a, u) for a, u in zip(acc_hi, upd))
+        return (kc, vc, acc_lo, acc_hi), None
+
+    if p > 1:
+        (kc, vc, acc_lo, acc_hi), _ = lax.scan(
+            step, (k, v, acc_lo, acc_hi), jnp.arange(1, p)
+        )
+    o_lo = acc_lo[0] / jnp.maximum(acc_lo[2], 1e-30)[..., None]
+    o_hi = acc_hi[0] / jnp.maximum(acc_hi[2], 1e-30)[..., None]
+    return jnp.concatenate([o_lo, o_hi], axis=-2).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str, is_causal: bool = False,

@@ -229,6 +229,45 @@ class TestRingAttention:
             out.numpy(), _ref_attention(q, k, v, is_causal=True), rtol=2e-4, atol=2e-4
         )
 
+    def test_zigzag_ring_causal_parity(self):
+        """Zigzag causal ring (balanced chunk assignment, half the plain ring's
+        FLOPs) matches dense causal attention after the layout round-trip."""
+        import jax
+        from functools import partial
+        from jax.sharding import PartitionSpec as P
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs a distributed mesh")
+        from heat_tpu.nn.attention import (
+            _dense_attention,
+            ring_attention_zigzag,
+            zigzag_inverse,
+            zigzag_order,
+        )
+
+        comm = ht.get_comm()
+        p_ = comm.size
+        B, H, T, D = 2, 2, 8 * p_, 8
+        rng = np.random.default_rng(13)
+        q = rng.standard_normal((B, H, T, D)).astype(np.float32)
+        k = rng.standard_normal((B, H, T, D)).astype(np.float32)
+        v = rng.standard_normal((B, H, T, D)).astype(np.float32)
+        order, inv = zigzag_order(T, p_), zigzag_inverse(T, p_)
+        assert np.array_equal(order[inv], np.arange(T))
+        spec = P(None, None, comm.axis_name, None)
+        fn = jax.jit(
+            jax.shard_map(
+                partial(ring_attention_zigzag, axis_name=comm.axis_name),
+                mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            )
+        )
+        qz, kz, vz = (jnp.asarray(x[..., order, :]) for x in (q, k, v))
+        got = np.asarray(fn(qz, kz, vz))[..., inv, :]
+        want = np.asarray(
+            _dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), is_causal=True)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
 
 class TestUlyssesAttention:
     @pytest.mark.parametrize("is_causal", [False, True])

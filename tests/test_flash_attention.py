@@ -70,6 +70,50 @@ class TestFlashKernel:
         assert use_flash(q, k, k, None, interpret=True)
 
 
+    def test_mask_fwd_parity_interpret(self):
+        """(Tq, Tk) bool and additive-float masks stream through the kernel and
+        match the dense reference, including fully-masked rows (output 0)."""
+        from heat_tpu.core.kernels.flash_attention import _as_bias
+        from heat_tpu.nn.attention import _dense_attention
+
+        rng = np.random.default_rng(9)
+        shape = (1, 2, 1024, 64)
+        q, k, v = (jnp.array(rng.standard_normal(shape), jnp.float32) for _ in range(3))
+        bool_mask = jnp.array(rng.random((1024, 1024)) > 0.3)
+        bool_mask = bool_mask.at[5].set(False)  # a fully-masked query row
+        float_mask = jnp.where(bool_mask, 0.0, -1e9).astype(jnp.float32)
+        for mask in (bool_mask, float_mask):
+            got = _flash_pallas(
+                q, k, v, False, 0.125, 512, 512,
+                interpret=True, bias=_as_bias(mask),
+            )[0]
+            want = _dense_attention(q, k, v, mask=mask, scale=0.125)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+            )
+            if mask.dtype == jnp.bool_:
+                # a fully bool-masked row outputs exactly 0 (l = 0); a finite
+                # additive mask (-1e9) instead degrades to uniform attention,
+                # identically in the dense path
+                assert float(jnp.max(jnp.abs(got[:, :, 5]))) == 0.0
+
+    def test_mask_plus_causal_parity_interpret(self):
+        """Causal scheduling and a streamed mask compose: blocks above the
+        diagonal stay absent from the schedule, the mask applies to the rest."""
+        from heat_tpu.core.kernels.flash_attention import _as_bias
+        from heat_tpu.nn.attention import _dense_attention
+
+        rng = np.random.default_rng(11)
+        shape = (1, 2, 1024, 64)
+        q, k, v = (jnp.array(rng.standard_normal(shape), jnp.float32) for _ in range(3))
+        mask = jnp.array(rng.random((1024, 1024)) > 0.2)
+        got = _flash_pallas(
+            q, k, v, True, 0.125, 512, 512, interpret=True, bias=_as_bias(mask)
+        )[0]
+        want = _dense_attention(q, k, v, mask=mask, is_causal=True, scale=0.125)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
 class TestFlashBackward:
     @pytest.mark.parametrize("causal", [False, True])
     def test_bwd_interpret_parity(self, causal):
@@ -151,33 +195,6 @@ class TestFlashBackward:
         arrays."""
         q = jnp.zeros((1, 1, 1 << 21, 64), jnp.bfloat16)
         assert not use_flash(q, q, q, None, interpret=True)
-
-    def test_mask_fwd_parity_interpret(self):
-        """(Tq, Tk) bool and additive-float masks stream through the kernel and
-        match the dense reference, including fully-masked rows (output 0)."""
-        from heat_tpu.core.kernels.flash_attention import _as_bias
-        from heat_tpu.nn.attention import _dense_attention
-
-        rng = np.random.default_rng(9)
-        shape = (1, 2, 1024, 64)
-        q, k, v = (jnp.array(rng.standard_normal(shape), jnp.float32) for _ in range(3))
-        bool_mask = jnp.array(rng.random((1024, 1024)) > 0.3)
-        bool_mask = bool_mask.at[5].set(False)  # a fully-masked query row
-        float_mask = jnp.where(bool_mask, 0.0, -1e9).astype(jnp.float32)
-        for mask in (bool_mask, float_mask):
-            got = _flash_pallas(
-                q, k, v, False, 0.125, 512, 512,
-                interpret=True, bias=_as_bias(mask),
-            )[0]
-            want = _dense_attention(q, k, v, mask=mask, scale=0.125)
-            np.testing.assert_allclose(
-                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
-            )
-            if mask.dtype == jnp.bool_:
-                # a fully bool-masked row outputs exactly 0 (l = 0); a finite
-                # additive mask (-1e9) instead degrades to uniform attention,
-                # identically in the dense path
-                assert float(jnp.max(jnp.abs(got[:, :, 5]))) == 0.0
 
     def test_mask_bwd_parity_interpret(self):
         from heat_tpu.core.kernels.flash_attention import (
