@@ -54,6 +54,29 @@ __all__ = [
 _NEG_INF = float(np.finfo(np.float32).min)
 
 
+def _attention_weights(q, k, mask, is_causal, scale):
+    """Normalized (row-stochastic, fully-masked rows → 0) attention weights in
+    f32 — the shared score/causal/mask/stabilized-softmax pipeline of the XLA
+    paths (with and without dropout)."""
+    d = q.shape[-1]
+    s = (1.0 / math.sqrt(d)) if scale is None else scale
+    scores = jnp.einsum(
+        "...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32
+    ) * jnp.float32(s)
+    if is_causal:
+        causal = jnp.arange(q.shape[-2])[:, None] >= jnp.arange(k.shape[-2])[None, :]
+        scores = jnp.where(causal, scores, _NEG_INF)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, _NEG_INF)
+        else:
+            scores = scores + mask.astype(jnp.float32)
+    # rows where everything is masked: keep them finite; their weights are 0
+    m = jnp.maximum(jnp.max(scores, axis=-1, keepdims=True), _NEG_INF / 2)
+    p = jnp.exp(scores - m)
+    return p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+
+
 def _dense_attention(q, k, v, mask=None, is_causal=False, scale=None):
     """Single-device exact attention on local arrays, f32 accumulation.
 
@@ -66,39 +89,66 @@ def _dense_attention(q, k, v, mask=None, is_causal=False, scale=None):
 
     if use_flash(q, k, v, mask, scale):
         return flash_attention(q, k, v, is_causal, scale, mask)
-    d = q.shape[-1]
-    s = (1.0 / math.sqrt(d)) if scale is None else scale
-    scores = jnp.einsum(
-        "...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32
-    ) * jnp.float32(s)
-    if is_causal:
-        q_pos = jnp.arange(q.shape[-2])
-        k_pos = jnp.arange(k.shape[-2])
-        causal = q_pos[:, None] >= k_pos[None, :]
-        scores = jnp.where(causal, scores, _NEG_INF)
-    if mask is not None:
-        if mask.dtype == jnp.bool_:
-            scores = jnp.where(mask, scores, _NEG_INF)
-        else:
-            scores = scores + mask.astype(jnp.float32)
-    m = jnp.max(scores, axis=-1, keepdims=True)
-    # rows where everything is masked: keep them finite; their output is 0
-    m = jnp.maximum(m, _NEG_INF / 2)
-    p = jnp.exp(scores - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("...qk,...kd->...qd", p, v, preferred_element_type=jnp.float32)
-    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    pw = _attention_weights(q, k, mask, is_causal, scale)
+    return jnp.einsum(
+        "...qk,...kd->...qd", pw, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
-                                 is_causal: bool = False, scale: Optional[float] = None):
-    """torch.nn.functional.scaled_dot_product_attention semantics.
+                                 dropout_p: float = 0.0,
+                                 is_causal: bool = False,
+                                 scale: Optional[float] = None,
+                                 enable_gqa: bool = False,
+                                 dropout_key=None):
+    """torch.nn.functional.scaled_dot_product_attention semantics (full signature:
+    ``attn_mask, dropout_p, is_causal, scale, enable_gqa``).
 
     Inputs are (..., T, D) — typically (B, H, T, D). On plain arrays this is one
     fused XLA program. On DNDarrays split along the sequence axis (dim -2) it runs
     :func:`ring_attention` under ``shard_map`` — context parallelism without the
     caller changing a line.
+
+    ``enable_gqa`` broadcasts grouped k/v heads (Hkv dividing Hq) like torch.
+    ``dropout_p`` applies torch's train-time inverted attention dropout (drop
+    probabilities after softmax, rescale kept ones by 1/(1-p)) and needs an
+    explicit ``dropout_key`` (jax has no ambient RNG state); it forces the XLA
+    path.
     """
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+    if dropout_p:
+        if dropout_key is None:
+            raise ValueError(
+                "dropout_p > 0 needs an explicit dropout_key PRNG key (jax has no "
+                "ambient RNG state like torch)"
+            )
+    if enable_gqa:
+        hq = query.shape[-3]
+        hkv = key.shape[-3]
+        if value.shape[-3] != hkv:
+            raise ValueError(
+                f"enable_gqa needs key and value to share a head count, got "
+                f"{hkv} and {value.shape[-3]}"
+            )
+        if hq != hkv:
+            if hq % hkv:
+                raise ValueError(f"enable_gqa needs Hkv | Hq, got {hkv}, {hq}")
+            rep = hq // hkv
+            key = _repeat_kv_heads(key, rep)
+            value = _repeat_kv_heads(value, rep)
+    if dropout_p:
+        q_ = query.larray if isinstance(query, DNDarray) else query
+        k_ = key.larray if isinstance(key, DNDarray) else key
+        v_ = value.larray if isinstance(value, DNDarray) else value
+        m_ = attn_mask.larray if isinstance(attn_mask, DNDarray) else attn_mask
+        out = _dense_attention_dropout(q_, k_, v_, m_, is_causal, scale,
+                                       dropout_p, dropout_key)
+        if isinstance(query, DNDarray):
+            from ..core._operations import wrap_result
+
+            return wrap_result(out, query, query.split)
+        return out
     if isinstance(query, DNDarray):
         from ..core._operations import wrap_result
 
@@ -149,6 +199,28 @@ def _online_attend(q_blk, q_pos, o, m, l, k_blk, v_blk, k_pos, s, masked: bool):
         "...qk,...kd->...qd", pij, v_blk, preferred_element_type=jnp.float32
     )
     return o_new, m_new, l_new
+
+
+def _repeat_kv_heads(x, rep: int):
+    """GQA: tile k/v heads to match the query head count (torch enable_gqa)."""
+    if isinstance(x, DNDarray):
+        from ..core._operations import wrap_result
+
+        v = jnp.repeat(x.larray, rep, axis=-3)
+        split = x.split  # the head axis is -3; seq/batch splits survive the repeat
+        return wrap_result(v, x, split)
+    return jnp.repeat(x, rep, axis=-3)
+
+
+def _dense_attention_dropout(q, k, v, mask, is_causal, scale, dropout_p, key):
+    """Dense attention with torch's train-time inverted attention dropout: drop
+    probabilities after softmax, rescale kept ones by 1/(1-p)."""
+    pw = _attention_weights(q, k, mask, is_causal, scale)
+    keep = jax.random.bernoulli(key, 1.0 - dropout_p, pw.shape)
+    pw = jnp.where(keep, pw / (1.0 - dropout_p), 0.0)
+    return jnp.einsum(
+        "...qk,...kd->...qd", pw, v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
 
 
 def ring_attention(q, k, v, axis_name: str, is_causal: bool = False,
@@ -293,11 +365,7 @@ def ring_attention_zigzag(q, k, v, axis_name: str, scale: Optional[float] = None
     acc_hi = attend_block(q_hi, hi_pos_of(my), *acc_hi, k_hi, v_hi, hi_pos_of(my), True)
     acc_hi = attend_block(q_hi, hi_pos_of(my), *acc_hi, k_lo, v_lo, lo_pos, False)
 
-    def step(carry, step_idx):
-        kc, vc, acc_lo, acc_hi = carry
-        kc = lax.ppermute(kc, axis_name, perm)
-        vc = lax.ppermute(vc, axis_name, perm)
-        src = (my + step_idx) % p  # device whose pair we now hold
+    def attend_pair(kc, vc, src, acc_lo, acc_hi):
         k_lo, k_hi = kc[..., :c, :], kc[..., c:, :]
         v_lo, v_hi = vc[..., :c, :], vc[..., c:, :]
         # hi queries × src's LOW keys: always needed (2p-1-my > src for src != my)
@@ -320,12 +388,26 @@ def ring_attention_zigzag(q, k, v, axis_name: str, scale: Optional[float] = None
         )
         acc_lo = tuple(jnp.where(pred, u, a) for u, a in zip(upd, acc_lo))
         acc_hi = tuple(jnp.where(pred, a, u) for a, u in zip(acc_hi, upd))
-        return (kc, vc, acc_lo, acc_hi), None
+        return acc_lo, acc_hi
+
+    def step(carry, step_idx):
+        kc, vc, acc_lo, acc_hi = carry
+        # rotate the HELD pair onward while attending it — both only read kc/vc,
+        # so the ICI transfer overlaps the matmuls (same structure as the plain
+        # ring); the final pair is consumed outside the scan with no dead hop
+        k_next = lax.ppermute(kc, axis_name, perm)
+        v_next = lax.ppermute(vc, axis_name, perm)
+        acc_lo, acc_hi = attend_pair(kc, vc, (my + step_idx) % p, acc_lo, acc_hi)
+        return (k_next, v_next, acc_lo, acc_hi), None
 
     if p > 1:
-        (kc, vc, acc_lo, acc_hi), _ = lax.scan(
-            step, (k, v, acc_lo, acc_hi), jnp.arange(1, p)
-        )
+        kc = lax.ppermute(k, axis_name, perm)
+        vc = lax.ppermute(v, axis_name, perm)
+        if p > 2:
+            (kc, vc, acc_lo, acc_hi), _ = lax.scan(
+                step, (kc, vc, acc_lo, acc_hi), jnp.arange(1, p - 1)
+            )
+        acc_lo, acc_hi = attend_pair(kc, vc, (my + p - 1) % p, acc_lo, acc_hi)
     o_lo = acc_lo[0] / jnp.maximum(acc_lo[2], 1e-30)[..., None]
     o_hi = acc_hi[0] / jnp.maximum(acc_hi[2], 1e-30)[..., None]
     return jnp.concatenate([o_lo, o_hi], axis=-2).astype(q.dtype)
