@@ -167,7 +167,46 @@ def _bench_attention(ht, jax, jnp, on_tpu):
     return b, h, t, d, flops / best / 1e12, masked_flops / best_m / 1e12
 
 
+def _backend_reachable(timeout_s: float = 150.0, attempts: int = 3) -> bool:
+    """Probe backend initialisation in a subprocess (killable — an in-process
+    ``jax.devices()`` against a dead relay blocks in C and ignores signals).
+    Retries because the axon relay has transient outages."""
+    import subprocess
+    import sys
+
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            if proc.returncode == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt < attempts - 1:
+            time.sleep(60)
+    return False
+
+
 def main():
+    import sys
+    import traceback
+
+    # matches the success-path name for the TPU shape so null datapoints join the series
+    _FAIL_METRIC = "matmul_32768x32768_bfloat16_split0x1_tflops_per_chip"
+
+    if not _backend_reachable():
+        # Emit a parseable line instead of hanging forever at round end.
+        print(json.dumps({
+            "metric": _FAIL_METRIC, "value": None, "unit": "TFLOP/s",
+            "vs_baseline": None,
+            "error": "accelerator backend unreachable (relay down); see BENCH_r02.json "
+                     "for the last recorded numbers",
+        }))
+        return
+
     import jax
     import jax.numpy as jnp
 
@@ -175,11 +214,46 @@ def main():
 
     on_tpu = jax.default_backend() != "cpu"
 
-    n, dtype_name, tflops = _bench_matmul(ht, jax, jnp, on_tpu)
-    kn, kd, kk, kmeans_s = _bench_kmeans(ht, jax, jnp, on_tpu)
-    hm, hn, hrank, hsvd_s = _bench_hsvd(ht, jax, jnp, on_tpu)
-    dn, dd, dh, dp_s = _bench_dp_step(ht, jax, jnp, on_tpu)
-    ab, ah, at, ad, attn_tflops, attn_masked_tflops = _bench_attention(ht, jax, jnp, on_tpu)
+    # The axon relay has transient ~1 min outages where every op fails; retry the
+    # headline metric, and isolate each extra so one flaky segment can't kill the
+    # whole JSON line the driver records.
+    tflops = None
+    for attempt in range(3):
+        try:
+            n, dtype_name, tflops = _bench_matmul(ht, jax, jnp, on_tpu)
+            break
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+            if attempt < 2:
+                time.sleep(60)
+    if tflops is None:
+        print(json.dumps({"metric": _FAIL_METRIC, "value": None,
+                          "unit": "TFLOP/s", "vs_baseline": None}))
+        return
+
+    extras = []
+
+    def guarded(fn, fmt):
+        try:
+            r = fmt(*fn(ht, jax, jnp, on_tpu))
+            extras.extend(r if isinstance(r, list) else [r])
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+
+    guarded(_bench_kmeans, lambda kn, kd, kk, s: {
+        "metric": f"kmeans_fit_{kn}x{kd}_k{kk}_30iter_split0",
+        "value": round(s, 3), "unit": "s"})
+    guarded(_bench_hsvd, lambda hm, hn, hrank, s: {
+        "metric": f"hsvd_rank_{hm}x{hn}_r{hrank}_split1",
+        "value": round(s, 3), "unit": "s"})
+    guarded(_bench_dp_step, lambda dn, dd, dh, s: {
+        "metric": f"dp_mlp_step_{dn}x{dd}_h{dh}_split0",
+        "value": round(s * 1e3, 3), "unit": "ms"})
+    guarded(_bench_attention, lambda ab, ah, at, ad, causal, masked: [
+        {"metric": f"attention_causal_b{ab}h{ah}t{at}d{ad}_tflops",
+         "value": round(causal, 3), "unit": "TFLOP/s"},
+        {"metric": f"attention_padmask_b{ab}h{ah}t{at}d{ad}_tflops",
+         "value": round(masked, 3), "unit": "TFLOP/s"}])
 
     # vs_baseline = fraction of the chip's bf16 matmul peak; CPU: no target
     peak = _peak_tflops(jax) if on_tpu else max(tflops, 1e-9)
@@ -190,33 +264,7 @@ def main():
                 "value": round(tflops, 3),
                 "unit": "TFLOP/s",
                 "vs_baseline": round(tflops / peak, 4),
-                "extra_metrics": [
-                    {
-                        "metric": f"kmeans_fit_{kn}x{kd}_k{kk}_30iter_split0",
-                        "value": round(kmeans_s, 3),
-                        "unit": "s",
-                    },
-                    {
-                        "metric": f"hsvd_rank_{hm}x{hn}_r{hrank}_split1",
-                        "value": round(hsvd_s, 3),
-                        "unit": "s",
-                    },
-                    {
-                        "metric": f"dp_mlp_step_{dn}x{dd}_h{dh}_split0",
-                        "value": round(dp_s * 1e3, 3),
-                        "unit": "ms",
-                    },
-                    {
-                        "metric": f"attention_causal_b{ab}h{ah}t{at}d{ad}_tflops",
-                        "value": round(attn_tflops, 3),
-                        "unit": "TFLOP/s",
-                    },
-                    {
-                        "metric": f"attention_padmask_b{ab}h{ah}t{at}d{ad}_tflops",
-                        "value": round(attn_masked_tflops, 3),
-                        "unit": "TFLOP/s",
-                    },
-                ],
+                "extra_metrics": extras,
             }
         )
     )

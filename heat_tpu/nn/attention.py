@@ -115,8 +115,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     explicit ``dropout_key`` (jax has no ambient RNG state); it forces the XLA
     path.
     """
-    if not 0.0 <= dropout_p < 1.0:
-        raise ValueError(f"dropout_p must be in [0, 1), got {dropout_p}")
+    if not 0.0 <= dropout_p <= 1.0:
+        raise ValueError(f"dropout_p must be in [0, 1], got {dropout_p}")
     if dropout_p:
         if dropout_key is None:
             raise ValueError(
@@ -215,6 +215,8 @@ def _repeat_kv_heads(x, rep: int):
 def _dense_attention_dropout(q, k, v, mask, is_causal, scale, dropout_p, key):
     """Dense attention with torch's train-time inverted attention dropout: drop
     probabilities after softmax, rescale kept ones by 1/(1-p)."""
+    if dropout_p == 1.0:  # torch: every weight dropped, output all-zero
+        return jnp.zeros(q.shape[:-1] + (v.shape[-1],), q.dtype)
     pw = _attention_weights(q, k, mask, is_causal, scale)
     keep = jax.random.bernoulli(key, 1.0 - dropout_p, pw.shape)
     pw = jnp.where(keep, pw / (1.0 - dropout_p), 0.0)

@@ -202,6 +202,18 @@ class TestDenseSDPA:
                 jnp.array(q), jnp.array(k), jnp.array(v), dropout_p=0.5,
                 enable_gqa=True,
             )
+        # torch accepts dropout_p=1.0 (every weight dropped -> all-zero output)
+        full = scaled_dot_product_attention(
+            jnp.array(q), jnp.array(k), jnp.array(v), enable_gqa=True,
+            dropout_p=1.0, dropout_key=_jax.random.key(0),
+        )
+        assert full.shape == q.shape[:-1] + (v.shape[-1],)
+        np.testing.assert_array_equal(np.asarray(full), 0.0)
+        with pytest.raises(ValueError):
+            scaled_dot_product_attention(
+                jnp.array(q), jnp.array(k), jnp.array(v), dropout_p=1.5,
+                enable_gqa=True, dropout_key=_jax.random.key(0),
+            )
 
     def test_torch_sdpa_parity(self):
         torch = pytest.importorskip("torch")
