@@ -228,7 +228,9 @@ def main():
                 time.sleep(60)
     if tflops is None:
         print(json.dumps({"metric": _FAIL_METRIC, "value": None,
-                          "unit": "TFLOP/s", "vs_baseline": None}))
+                          "unit": "TFLOP/s", "vs_baseline": None,
+                          "error": "matmul benchmark failed on all 3 attempts "
+                                   "(backend reachable; see stderr for tracebacks)"}))
         return
 
     extras = []

@@ -438,32 +438,59 @@ class MultiheadAttention(Module):
     """torch.nn.MultiheadAttention semantics (batch_first, self- or cross-attention).
 
     Packed in-projection weight (3E, E) + out-projection (E, E), both with torch's
-    xavier_uniform_ / zero-bias init, so state_dicts map 1:1. ``apply(params, x)``
+    xavier_uniform_ / zero-bias init, so state_dicts map 1:1 (with ``kdim``/``vdim``
+    differing from ``embed_dim``, separate ``q/k/v_proj_weight`` under torch's
+    names, like torch's ``_qkv_same_embed_dim=False`` path). ``apply(params, x)``
     is self-attention; ``apply(params, (q, k, v))`` is cross-attention. On
     sequence-split DNDarray inputs the underlying sdpa runs the ring schedule.
+
+    ``dropout`` is torch's attention-weight dropout: active only under
+    ``apply(..., train=True, key=...)`` (explicit PRNG key — jax has no ambient
+    RNG state); the eval-style ``mha(q, k, v)`` call never drops, like torch
+    modules in ``.eval()``.
     """
 
-    def __init__(self, embed_dim: int, num_heads: int, bias: bool = True,
-                 batch_first: bool = True):
+    def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
+                 bias: bool = True, batch_first: bool = True,
+                 kdim: Optional[int] = None, vdim: Optional[int] = None):
         if embed_dim % num_heads:
             raise ValueError("embed_dim must be divisible by num_heads")
+        if not 0.0 <= dropout <= 1.0:
+            raise ValueError(f"dropout must be in [0, 1], got {dropout}")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
         self.bias = bias
         self.batch_first = batch_first
+        self.kdim = embed_dim if kdim is None else kdim
+        self.vdim = embed_dim if vdim is None else vdim
+        # torch: packed (3E, E) in-projection only when q/k/v share the embed dim;
+        # otherwise separate q/k/v weights under torch's exact param names
+        self._qkv_same_embed_dim = self.kdim == embed_dim and self.vdim == embed_dim
 
     def init(self, key):
         e = self.embed_dim
-        k1, k2 = jax.random.split(key)
-        # torch's _reset_parameters: xavier_uniform_ on in_proj_weight and
-        # out_proj.weight, zeros on both biases
-        lim_in = math.sqrt(6.0 / (3 * e + e))
-        lim_out = math.sqrt(6.0 / (e + e))
-        params = {
-            "in_proj_weight": jax.random.uniform(k1, (3 * e, e), jnp.float32, -lim_in, lim_in),
-            "out_proj_weight": jax.random.uniform(k2, (e, e), jnp.float32, -lim_out, lim_out),
-        }
+        # torch's _reset_parameters: xavier_uniform_ on every projection weight,
+        # zeros on both biases
+        xavier = lambda k, shape: jax.random.uniform(
+            k, shape, jnp.float32,
+            -math.sqrt(6.0 / sum(shape)), math.sqrt(6.0 / sum(shape)),
+        )
+        if self._qkv_same_embed_dim:
+            k1, k2 = jax.random.split(key)
+            params = {
+                "in_proj_weight": xavier(k1, (3 * e, e)),
+                "out_proj_weight": xavier(k2, (e, e)),
+            }
+        else:
+            kq, kk, kv, k2 = jax.random.split(key, 4)
+            params = {
+                "q_proj_weight": xavier(kq, (e, e)),
+                "k_proj_weight": xavier(kk, (e, self.kdim)),
+                "v_proj_weight": xavier(kv, (e, self.vdim)),
+                "out_proj_weight": xavier(k2, (e, e)),
+            }
         if self.bias:
             params["in_proj_bias"] = jnp.zeros((3 * e,), jnp.float32)
             params["out_proj_bias"] = jnp.zeros((e,), jnp.float32)
@@ -508,10 +535,16 @@ class MultiheadAttention(Module):
             q_in, k_in, v_in = (jnp.swapaxes(t, 0, 1) for t in (q_in, k_in, v_in))
 
         e = self.embed_dim
-        w = params["in_proj_weight"]
         b = params.get("in_proj_bias")
-        proj = lambda t, i: t @ w[i * e:(i + 1) * e].T + (b[i * e:(i + 1) * e] if b is not None else 0.0)
-        q, k, v = proj(q_in, 0), proj(k_in, 1), proj(v_in, 2)
+        bias_of = lambda i: b[i * e:(i + 1) * e] if b is not None else 0.0
+        if self._qkv_same_embed_dim:
+            w = params["in_proj_weight"]
+            proj = lambda t, i: t @ w[i * e:(i + 1) * e].T + bias_of(i)
+            q, k, v = proj(q_in, 0), proj(k_in, 1), proj(v_in, 2)
+        else:
+            q = q_in @ params["q_proj_weight"].T + bias_of(0)
+            k = k_in @ params["k_proj_weight"].T + bias_of(1)
+            v = v_in @ params["v_proj_weight"].T + bias_of(2)
 
         def split_heads(t):  # (B, T, E) -> (B, H, T, hd)
             bsz, tlen, _ = t.shape
@@ -519,7 +552,28 @@ class MultiheadAttention(Module):
 
         qh, kh, vh = split_heads(q), split_heads(k), split_heads(v)
         comm = proto.comm if proto is not None else None
-        if (
+        if train and self.dropout > 0.0:
+            # torch: attention-weight dropout only in train mode; needs an
+            # explicit PRNG key (jax has no ambient RNG state)
+            if key is None:
+                raise ValueError(
+                    "MultiheadAttention with dropout > 0 needs apply(..., key=...) "
+                    "in train mode (jax has no ambient RNG state like torch)"
+                )
+            if seq_split:
+                import warnings
+
+                warnings.warn(
+                    "MultiheadAttention dropout forfeits the ring-attention path: "
+                    "the (T, T) weight matrix is materialized densely. For "
+                    "long-context training use dropout=0 (or drop residual "
+                    "streams instead).",
+                    stacklevel=2,
+                )
+            o = _dense_attention_dropout(
+                qh, kh, vh, attn_mask, is_causal, None, self.dropout, key
+            )
+        elif (
             seq_split
             and attn_mask is None
             and comm is not None
@@ -566,8 +620,16 @@ class MultiheadAttention(Module):
         if value is None:
             value = key
         x = query if (key is query and value is query) else (query, key, value)
+        # honor the bound train/key context like base Module.__call__ (the
+        # ``key`` name here is the attention key tensor, so the RNG key can only
+        # arrive via _bind from a parent apply(..., train=True, key=...) or via
+        # .train() mode)
+        ctx = getattr(self, "_ctx", None)
+        rng_key, train = ctx if ctx is not None else (
+            None, getattr(self, "_train_mode", False)
+        )
         out = self.apply(
-            self.params, x, attn_mask=attn_mask, is_causal=is_causal,
-            key_padding_mask=key_padding_mask,
+            self.params, x, key=rng_key, train=train, attn_mask=attn_mask,
+            is_causal=is_causal, key_padding_mask=key_padding_mask,
         )
         return out, None

@@ -56,7 +56,12 @@ __all__ = [
     "binary_cross_entropy_with_logits",
     "smooth_l1_loss",
     "huber_loss",
+    "scaled_dot_product_attention",
 ]
+
+# torch exposes sdpa under torch.nn.functional; same surface here (the
+# implementation lives with the ring/flash dispatch in ``..nn.attention``)
+from .attention import scaled_dot_product_attention  # noqa: E402
 
 
 def _pair(v) -> Tuple[int, int]:

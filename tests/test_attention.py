@@ -215,6 +215,75 @@ class TestDenseSDPA:
                 enable_gqa=True, dropout_key=_jax.random.key(0),
             )
 
+    def test_mha_kdim_vdim_torch_parity(self):
+        """torch's separate-projection path: kdim/vdim != embed_dim uses
+        q/k/v_proj_weight params under torch's exact names."""
+        torch = pytest.importorskip("torch")
+        import heat_tpu as ht
+
+        rng = np.random.default_rng(33)
+        B, Tq, Tk, E, H, KD, VD = 2, 5, 7, 8, 2, 12, 6
+        q = rng.standard_normal((B, Tq, E)).astype(np.float32)
+        k = rng.standard_normal((B, Tk, KD)).astype(np.float32)
+        v = rng.standard_normal((B, Tk, VD)).astype(np.float32)
+        tm = torch.nn.MultiheadAttention(E, H, kdim=KD, vdim=VD, batch_first=True)
+        hm = ht.nn.MultiheadAttention(E, H, kdim=KD, vdim=VD)
+        sd = tm.state_dict()
+        hm.params["q_proj_weight"] = jnp.asarray(sd["q_proj_weight"].numpy())
+        hm.params["k_proj_weight"] = jnp.asarray(sd["k_proj_weight"].numpy())
+        hm.params["v_proj_weight"] = jnp.asarray(sd["v_proj_weight"].numpy())
+        hm.params["in_proj_bias"] = jnp.asarray(sd["in_proj_bias"].numpy())
+        hm.params["out_proj_weight"] = jnp.asarray(sd["out_proj.weight"].numpy())
+        hm.params["out_proj_bias"] = jnp.asarray(sd["out_proj.bias"].numpy())
+        t_out, _ = tm(torch.tensor(q), torch.tensor(k), torch.tensor(v),
+                      need_weights=False)
+        h_out, _ = hm(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(
+            np.asarray(h_out), t_out.detach().numpy(), rtol=1e-5, atol=1e-5
+        )
+        # init produces the torch param-name set
+        fresh = hm.init(jax.random.key(0)) if hasattr(hm, "init") else {}
+        assert {"q_proj_weight", "k_proj_weight", "v_proj_weight"} <= set(fresh)
+
+    def test_mha_dropout(self):
+        """torch semantics: dropout only in train mode; eval __call__ never drops;
+        train mode needs an explicit PRNG key; dropless train == eval."""
+        import heat_tpu as ht
+        import jax as _jax
+
+        rng = np.random.default_rng(31)
+        B, T, E, H = 2, 6, 8, 2
+        x = jnp.array(rng.standard_normal((B, T, E)).astype(np.float32))
+        mha = ht.nn.MultiheadAttention(E, H, dropout=0.5)
+        params = mha.params
+        eval_out, _ = mha(x)
+        # train w/o key raises; with key drops (differs from eval and across keys)
+        with pytest.raises(ValueError):
+            mha.apply(params, x, train=True)
+        t1 = mha.apply(params, x, train=True, key=_jax.random.key(1))
+        t2 = mha.apply(params, x, train=True, key=_jax.random.key(2))
+        assert not np.allclose(np.asarray(t1), np.asarray(eval_out))
+        assert not np.allclose(np.asarray(t1), np.asarray(t2))
+        # train=False ignores dropout entirely
+        np.testing.assert_array_equal(
+            np.asarray(mha.apply(params, x)), np.asarray(eval_out)
+        )
+        with pytest.raises(ValueError):
+            ht.nn.MultiheadAttention(E, H, dropout=-0.1)
+        # torch-style __call__ honors train()/bound context: .train() without a
+        # key fails loudly (no silent no-drop); a bound _ctx (what a parent
+        # apply(..., train=True, key=...) installs) activates dropout
+        mha.train()
+        with pytest.raises(ValueError):
+            mha(x)
+        mha._ctx = (_jax.random.key(3), True)
+        bound_out, _ = mha(x)
+        assert not np.allclose(np.asarray(bound_out), np.asarray(eval_out))
+        del mha._ctx
+        mha.eval()
+        again, _ = mha(x)
+        np.testing.assert_array_equal(np.asarray(again), np.asarray(eval_out))
+
     def test_torch_sdpa_parity(self):
         torch = pytest.importorskip("torch")
         rng = np.random.default_rng(3)

@@ -12,7 +12,7 @@ import pytest
 
 import heat_tpu as ht
 
-N_CASES = 24
+N_CASES = int(__import__("os").environ.get("HEAT_TPU_FUZZ_CASES", "24"))  # scale up for long fuzz sessions
 
 
 def _mk(rng, shape, dtype=np.float32):

@@ -16,7 +16,7 @@ import torch.nn.functional as tF  # noqa: E402
 import heat_tpu as ht  # noqa: E402
 import heat_tpu.nn.functional as F  # noqa: E402
 
-N_CASES = 12
+N_CASES = int(__import__("os").environ.get("HEAT_TPU_FUZZ_CASES", "12"))  # scale up for long fuzz sessions
 
 
 def _chk(got, want_t, case, rtol=1e-4, atol=1e-4):
