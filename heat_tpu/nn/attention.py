@@ -49,6 +49,8 @@ __all__ = [
     "zigzag_inverse",
     "ulysses_attention",
     "MultiheadAttention",
+    "TransformerEncoderLayer",
+    "TransformerEncoder",
 ]
 
 _NEG_INF = float(np.finfo(np.float32).min)
@@ -138,6 +140,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             key = _repeat_kv_heads(key, rep)
             value = _repeat_kv_heads(value, rep)
     if dropout_p:
+        if isinstance(query, DNDarray) and query.split == query.ndim - 2:
+            import warnings
+
+            warnings.warn(
+                "scaled_dot_product_attention dropout forfeits the ring-attention "
+                "path on sequence-split inputs: the (T, T) weight matrix is "
+                "materialized densely. Use dropout_p=0 for long-context runs.",
+                stacklevel=2,
+            )
         q_ = query.larray if isinstance(query, DNDarray) else query
         k_ = key.larray if isinstance(key, DNDarray) else key
         v_ = value.larray if isinstance(value, DNDarray) else value
@@ -633,3 +644,172 @@ class MultiheadAttention(Module):
             is_causal=is_causal, key_padding_mask=key_padding_mask,
         )
         return out, None
+
+
+def _keyed_dropout(x, p: float, key, train: bool):
+    """Inverted dropout on a jax.Array or DNDarray (explicit key; inert in eval)
+    — delegates to :func:`heat_tpu.nn.functional.dropout`, which preserves any
+    split (elementwise op)."""
+    from . import functional as F
+
+    return F.dropout(x, p, training=train, key=key)
+
+
+class TransformerEncoderLayer(Module):
+    """torch.nn.TransformerEncoderLayer semantics (self-attention + feedforward,
+    post-norm by default, ``norm_first`` pre-norm variant).
+
+    The reference exposes this via its torch fall-through (``nn/__init__.py:18-31``);
+    here it composes the native :class:`MultiheadAttention` (ring dispatch on
+    sequence-split DNDarrays), :class:`~heat_tpu.nn.modules.Linear` and LayerNorm,
+    so the whole layer jits to one XLA program. ``batch_first`` defaults True (the
+    TPU-natural layout, unlike torch's False default — see the deviations page);
+    dropout needs ``apply(..., train=True, key=...)``.
+    """
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int = 2048,
+                 dropout: float = 0.1, activation="relu",
+                 layer_norm_eps: float = 1e-5, batch_first: bool = True,
+                 norm_first: bool = False, bias: bool = True):
+        from .modules import LayerNorm, Linear
+
+        self.self_attn = MultiheadAttention(
+            d_model, nhead, dropout=dropout, bias=bias, batch_first=batch_first
+        )
+        self.linear1 = Linear(d_model, dim_feedforward, bias=bias)
+        self.linear2 = Linear(dim_feedforward, d_model, bias=bias)
+        self.norm1 = LayerNorm(d_model, eps=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, eps=layer_norm_eps)
+        self.dropout_p = dropout
+        self.norm_first = norm_first
+        if callable(activation):
+            self.activation = activation
+        elif activation == "relu":
+            from . import functional as F
+
+            self.activation = F.relu
+        elif activation == "gelu":
+            from . import functional as F
+
+            self.activation = F.gelu
+        else:
+            raise ValueError(f"activation must be 'relu', 'gelu' or a callable, got {activation!r}")
+
+    def init(self, key):
+        ks = jax.random.split(key, 5)
+        return {
+            "self_attn": self.self_attn.init(ks[0]),
+            "linear1": self.linear1.init(ks[1]),
+            "linear2": self.linear2.init(ks[2]),
+            "norm1": self.norm1.init(ks[3]),
+            "norm2": self.norm2.init(ks[4]),
+        }
+
+    def _sa_block(self, params, x, key, train, src_mask, src_key_padding_mask,
+                  is_causal):
+        k_attn, k_drop = (
+            jax.random.split(key) if key is not None else (None, None)
+        )
+        out = self.self_attn.apply(
+            params["self_attn"], x, key=k_attn, train=train, attn_mask=src_mask,
+            key_padding_mask=src_key_padding_mask, is_causal=is_causal,
+        )
+        return _keyed_dropout(out, self.dropout_p, k_drop, train)
+
+    def _ff_block(self, params, x, key, train):
+        k1, k2 = jax.random.split(key) if key is not None else (None, None)
+        h = self.activation(self.linear1.apply(params["linear1"], x))
+        h = _keyed_dropout(h, self.dropout_p, k1, train)
+        h = self.linear2.apply(params["linear2"], h)
+        return _keyed_dropout(h, self.dropout_p, k2, train)
+
+    def apply(self, params, src, *, key=None, train=False, src_mask=None,
+              src_key_padding_mask=None, is_causal: bool = False):
+        k_sa, k_ff = jax.random.split(key) if key is not None else (None, None)
+        norm1 = lambda v: self.norm1.apply(params["norm1"], v)
+        norm2 = lambda v: self.norm2.apply(params["norm2"], v)
+        x = src
+        if self.norm_first:
+            x = x + self._sa_block(params, norm1(x), k_sa, train, src_mask,
+                                   src_key_padding_mask, is_causal)
+            x = x + self._ff_block(params, norm2(x), k_ff, train)
+        else:
+            x = norm1(x + self._sa_block(params, x, k_sa, train, src_mask,
+                                         src_key_padding_mask, is_causal))
+            x = norm2(x + self._ff_block(params, x, k_ff, train))
+        return x
+
+    def __call__(self, src, src_mask=None, src_key_padding_mask=None,
+                 is_causal: bool = False, *, key=None, train=None):
+        ctx = getattr(self, "_ctx", None)
+        if ctx is not None:
+            if key is None:
+                key = ctx[0]
+            if train is None:
+                train = ctx[1]
+        if train is None:
+            train = getattr(self, "_train_mode", False)
+        return self.apply(
+            self.params, src, key=key, train=train, src_mask=src_mask,
+            src_key_padding_mask=src_key_padding_mask, is_causal=is_causal,
+        )
+
+
+class TransformerEncoder(Module):
+    """torch.nn.TransformerEncoder: N independently-parameterised copies of an
+    encoder layer (same hyperparameters, fresh params per layer), plus an
+    optional final norm."""
+
+    def __init__(self, encoder_layer: TransformerEncoderLayer, num_layers: int,
+                 norm=None):
+        import copy
+
+        self.layers = [copy.deepcopy(encoder_layer) for _ in range(num_layers)]
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def named_submodules(self):
+        subs = [(str(i), m) for i, m in enumerate(self.layers)]
+        if self.norm is not None:
+            subs.append(("norm", self.norm))
+        return subs
+
+    def init(self, key):
+        ks = jax.random.split(key, self.num_layers + 1)
+        params = {str(i): m.init(k) for (i, m), k in
+                  zip(enumerate(self.layers), ks)}
+        if self.norm is not None:
+            params["norm"] = self.norm.init(ks[-1])
+        return params
+
+    def apply(self, params, src, *, key=None, train=False, src_mask=None,
+              src_key_padding_mask=None, is_causal: bool = False):
+        ks = (
+            jax.random.split(key, self.num_layers)
+            if key is not None
+            else [None] * self.num_layers
+        )
+        x = src
+        for i, (layer, k) in enumerate(zip(self.layers, ks)):
+            x = layer.apply(params[str(i)], x, key=k, train=train,
+                            src_mask=src_mask,
+                            src_key_padding_mask=src_key_padding_mask,
+                            is_causal=is_causal)
+        if self.norm is not None:
+            x = self.norm.apply(params["norm"], x)
+        return x
+
+    def __call__(self, src, src_mask=None, src_key_padding_mask=None,
+                 is_causal: bool = False, *, key=None, train=None):
+        ctx = getattr(self, "_ctx", None)
+        if ctx is not None:
+            if key is None:
+                key = ctx[0]
+            if train is None:
+                train = ctx[1]
+        if train is None:
+            train = getattr(self, "_train_mode", False)
+        return self.apply(
+            self.params, src, key=key, train=train, src_mask=src_mask,
+            src_key_padding_mask=src_key_padding_mask, is_causal=is_causal,
+        )

@@ -213,8 +213,13 @@ def dropout(x, p: float = 0.5, training: bool = True, key: Optional[jax.Array] =
     if key is None:
         raise ValueError("dropout in training mode needs an explicit PRNG key")
     keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
-    out = jnp.where(keep, v / (1.0 - p), 0.0)
-    return _rewrap(out, proto) if proto is not None else out
+    out = jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+    if proto is None:
+        return out
+    from ..core._operations import wrap_result
+
+    # elementwise: any split survives (not just batch)
+    return wrap_result(out, proto, proto.split)
 
 
 def dropout2d(x, p: float = 0.5, training: bool = True, key: Optional[jax.Array] = None):
@@ -269,7 +274,14 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, eps: float = 1e-5):
         out = out * weight
     if bias is not None:
         out = out + bias
-    return _rewrap(out, proto) if proto is not None else out
+    if proto is None:
+        return out
+    from ..core._operations import wrap_result
+
+    # statistics are per-position over the trailing normalized axes, so any
+    # split on a leading axis (batch OR sequence) survives untouched
+    keep = proto.split if (proto.split is not None and proto.split not in axes) else None
+    return wrap_result(out, proto, keep)
 
 
 def flatten(x, start_dim: int = 0, end_dim: int = -1):

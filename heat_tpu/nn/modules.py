@@ -164,10 +164,22 @@ class Module:
         if train is None:
             train = getattr(self, "_train_mode", False)
         value = self.apply(self.params, _to_value(x), key=key, train=train)
-        if isinstance(x, DNDarray):
+        if isinstance(x, DNDarray) and not isinstance(value, DNDarray):
             from ..core._operations import wrap_result
 
-            return wrap_result(value, x, x.split if x.split == 0 else None)
+            # a split survives whenever its axis still exists with the same
+            # global extent (batch through convs/embedding, sequence through
+            # norms/linear); axes the op consumed or resized fall back to
+            # replicated. split is a layout over a global array, so a
+            # false-positive keep is a layout choice, never wrong data.
+            keep = None
+            if (
+                x.split is not None
+                and x.split < value.ndim
+                and value.shape[x.split] == x.shape[x.split]
+            ):
+                keep = x.split
+            return wrap_result(value, x, keep)
         return value
 
 
@@ -193,9 +205,16 @@ class Linear(Module):
         return {"weight": w, "bias": b}
 
     def apply(self, params, x, *, key=None, train=False):
-        y = x @ params["weight"]
+        v = _to_value(x)
+        y = v @ params["weight"]
         if self.bias:
             y = y + params["bias"]
+        if isinstance(x, DNDarray):
+            from ..core._operations import wrap_result
+
+            # the feature axis is mixed by the product; leading splits survive
+            keep = x.split if (x.split is not None and x.split < x.ndim - 1) else None
+            return wrap_result(y, x, keep)
         return y
 
 
