@@ -51,6 +51,8 @@ __all__ = [
     "MultiheadAttention",
     "TransformerEncoderLayer",
     "TransformerEncoder",
+    "TransformerDecoderLayer",
+    "TransformerDecoder",
 ]
 
 _NEG_INF = float(np.finfo(np.float32).min)
@@ -635,10 +637,7 @@ class MultiheadAttention(Module):
         # ``key`` name here is the attention key tensor, so the RNG key can only
         # arrive via _bind from a parent apply(..., train=True, key=...) or via
         # .train() mode)
-        ctx = getattr(self, "_ctx", None)
-        rng_key, train = ctx if ctx is not None else (
-            None, getattr(self, "_train_mode", False)
-        )
+        rng_key, train = self._resolve_ctx()
         out = self.apply(
             self.params, x, key=rng_key, train=train, attn_mask=attn_mask,
             is_causal=is_causal, key_padding_mask=key_padding_mask,
@@ -655,7 +654,72 @@ def _keyed_dropout(x, p: float, key, train: bool):
     return F.dropout(x, p, training=train, key=key)
 
 
-class TransformerEncoderLayer(Module):
+def _resolve_activation(activation):
+    """'relu' / 'gelu' / any callable — the torch TransformerXLayer contract."""
+    if callable(activation):
+        return activation
+    if activation in ("relu", "gelu"):
+        from . import functional as F
+
+        return getattr(F, activation)
+    raise ValueError(
+        f"activation must be 'relu', 'gelu' or a callable, got {activation!r}"
+    )
+
+
+class _FeedForwardMixin:
+    """The linear1 → activation → dropout → linear2 → dropout block shared by the
+    encoder and decoder layers (expects self.linear1/linear2/activation/dropout_p)."""
+
+    def _ff_block(self, params, x, key, train):
+        k1, k2 = jax.random.split(key) if key is not None else (None, None)
+        h = self.activation(self.linear1.apply(params["linear1"], x))
+        h = _keyed_dropout(h, self.dropout_p, k1, train)
+        h = self.linear2.apply(params["linear2"], h)
+        return _keyed_dropout(h, self.dropout_p, k2, train)
+
+
+class _LayerStack(Module):
+    """N fresh-parameter deep copies of a layer plus an optional final norm —
+    the shared container shape of TransformerEncoder and TransformerDecoder."""
+
+    def __init__(self, layer, num_layers: int, norm=None):
+        import copy
+
+        self.layers = [copy.deepcopy(layer) for _ in range(num_layers)]
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def named_submodules(self):
+        subs = [(str(i), m) for i, m in enumerate(self.layers)]
+        if self.norm is not None:
+            subs.append(("norm", self.norm))
+        return subs
+
+    def init(self, key):
+        ks = jax.random.split(key, self.num_layers + 1)
+        params = {str(i): m.init(k) for (i, m), k in
+                  zip(enumerate(self.layers), ks)}
+        if self.norm is not None:
+            params["norm"] = self.norm.init(ks[-1])
+        return params
+
+    def _run_stack(self, params, x, key, train, call):
+        """Thread x through the layers (per-layer key split), then the final norm.
+        ``call(layer, layer_params, x, k)`` runs one layer."""
+        ks = (
+            jax.random.split(key, self.num_layers)
+            if key is not None
+            else [None] * self.num_layers
+        )
+        for i, (layer, k) in enumerate(zip(self.layers, ks)):
+            x = call(layer, params[str(i)], x, k)
+        if self.norm is not None:
+            x = self.norm.apply(params["norm"], x)
+        return x
+
+
+class TransformerEncoderLayer(_FeedForwardMixin, Module):
     """torch.nn.TransformerEncoderLayer semantics (self-attention + feedforward,
     post-norm by default, ``norm_first`` pre-norm variant).
 
@@ -682,18 +746,7 @@ class TransformerEncoderLayer(Module):
         self.norm2 = LayerNorm(d_model, eps=layer_norm_eps)
         self.dropout_p = dropout
         self.norm_first = norm_first
-        if callable(activation):
-            self.activation = activation
-        elif activation == "relu":
-            from . import functional as F
-
-            self.activation = F.relu
-        elif activation == "gelu":
-            from . import functional as F
-
-            self.activation = F.gelu
-        else:
-            raise ValueError(f"activation must be 'relu', 'gelu' or a callable, got {activation!r}")
+        self.activation = _resolve_activation(activation)
 
     def init(self, key):
         ks = jax.random.split(key, 5)
@@ -716,13 +769,6 @@ class TransformerEncoderLayer(Module):
         )
         return _keyed_dropout(out, self.dropout_p, k_drop, train)
 
-    def _ff_block(self, params, x, key, train):
-        k1, k2 = jax.random.split(key) if key is not None else (None, None)
-        h = self.activation(self.linear1.apply(params["linear1"], x))
-        h = _keyed_dropout(h, self.dropout_p, k1, train)
-        h = self.linear2.apply(params["linear2"], h)
-        return _keyed_dropout(h, self.dropout_p, k2, train)
-
     def apply(self, params, src, *, key=None, train=False, src_mask=None,
               src_key_padding_mask=None, is_causal: bool = False):
         k_sa, k_ff = jax.random.split(key) if key is not None else (None, None)
@@ -741,75 +787,147 @@ class TransformerEncoderLayer(Module):
 
     def __call__(self, src, src_mask=None, src_key_padding_mask=None,
                  is_causal: bool = False, *, key=None, train=None):
-        ctx = getattr(self, "_ctx", None)
-        if ctx is not None:
-            if key is None:
-                key = ctx[0]
-            if train is None:
-                train = ctx[1]
-        if train is None:
-            train = getattr(self, "_train_mode", False)
+        key, train = self._resolve_ctx(key, train)
         return self.apply(
             self.params, src, key=key, train=train, src_mask=src_mask,
             src_key_padding_mask=src_key_padding_mask, is_causal=is_causal,
         )
 
 
-class TransformerEncoder(Module):
+class TransformerEncoder(_LayerStack):
     """torch.nn.TransformerEncoder: N independently-parameterised copies of an
     encoder layer (same hyperparameters, fresh params per layer), plus an
     optional final norm."""
 
-    def __init__(self, encoder_layer: TransformerEncoderLayer, num_layers: int,
-                 norm=None):
-        import copy
-
-        self.layers = [copy.deepcopy(encoder_layer) for _ in range(num_layers)]
-        self.num_layers = num_layers
-        self.norm = norm
-
-    def named_submodules(self):
-        subs = [(str(i), m) for i, m in enumerate(self.layers)]
-        if self.norm is not None:
-            subs.append(("norm", self.norm))
-        return subs
-
-    def init(self, key):
-        ks = jax.random.split(key, self.num_layers + 1)
-        params = {str(i): m.init(k) for (i, m), k in
-                  zip(enumerate(self.layers), ks)}
-        if self.norm is not None:
-            params["norm"] = self.norm.init(ks[-1])
-        return params
-
     def apply(self, params, src, *, key=None, train=False, src_mask=None,
               src_key_padding_mask=None, is_causal: bool = False):
-        ks = (
-            jax.random.split(key, self.num_layers)
-            if key is not None
-            else [None] * self.num_layers
+        return self._run_stack(
+            params, src, key, train,
+            lambda layer, p, x, k: layer.apply(
+                p, x, key=k, train=train, src_mask=src_mask,
+                src_key_padding_mask=src_key_padding_mask, is_causal=is_causal,
+            ),
         )
-        x = src
-        for i, (layer, k) in enumerate(zip(self.layers, ks)):
-            x = layer.apply(params[str(i)], x, key=k, train=train,
-                            src_mask=src_mask,
-                            src_key_padding_mask=src_key_padding_mask,
-                            is_causal=is_causal)
-        if self.norm is not None:
-            x = self.norm.apply(params["norm"], x)
-        return x
 
     def __call__(self, src, src_mask=None, src_key_padding_mask=None,
                  is_causal: bool = False, *, key=None, train=None):
-        ctx = getattr(self, "_ctx", None)
-        if ctx is not None:
-            if key is None:
-                key = ctx[0]
-            if train is None:
-                train = ctx[1]
-        if train is None:
-            train = getattr(self, "_train_mode", False)
+        key, train = self._resolve_ctx(key, train)
         return self.apply(
             self.params, src, key=key, train=train, src_mask=src_mask,
             src_key_padding_mask=src_key_padding_mask, is_causal=is_causal,
         )
+
+
+class TransformerDecoderLayer(_FeedForwardMixin, Module):
+    """torch.nn.TransformerDecoderLayer semantics: masked self-attention over the
+    target, cross-attention into the encoder memory, then feedforward — each with
+    residual + LayerNorm (post-norm default, ``norm_first`` pre-norm).
+
+    Same composition story as :class:`TransformerEncoderLayer`; the reference
+    reaches this through its torch fall-through (``nn/__init__.py:18-31``).
+    """
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int = 2048,
+                 dropout: float = 0.1, activation="relu",
+                 layer_norm_eps: float = 1e-5, batch_first: bool = True,
+                 norm_first: bool = False, bias: bool = True):
+        from .modules import LayerNorm, Linear
+
+        self.self_attn = MultiheadAttention(
+            d_model, nhead, dropout=dropout, bias=bias, batch_first=batch_first
+        )
+        self.multihead_attn = MultiheadAttention(
+            d_model, nhead, dropout=dropout, bias=bias, batch_first=batch_first
+        )
+        self.linear1 = Linear(d_model, dim_feedforward, bias=bias)
+        self.linear2 = Linear(dim_feedforward, d_model, bias=bias)
+        self.norm1 = LayerNorm(d_model, eps=layer_norm_eps)
+        self.norm2 = LayerNorm(d_model, eps=layer_norm_eps)
+        self.norm3 = LayerNorm(d_model, eps=layer_norm_eps)
+        self.dropout_p = dropout
+        self.norm_first = norm_first
+        self.activation = _resolve_activation(activation)
+
+    def init(self, key):
+        ks = jax.random.split(key, 7)
+        return {
+            "self_attn": self.self_attn.init(ks[0]),
+            "multihead_attn": self.multihead_attn.init(ks[1]),
+            "linear1": self.linear1.init(ks[2]),
+            "linear2": self.linear2.init(ks[3]),
+            "norm1": self.norm1.init(ks[4]),
+            "norm2": self.norm2.init(ks[5]),
+            "norm3": self.norm3.init(ks[6]),
+        }
+
+    def _attn_block(self, attn, params, q, kv, key, train, mask, padding_mask,
+                    is_causal):
+        k_attn, k_drop = (
+            jax.random.split(key) if key is not None else (None, None)
+        )
+        x = q if kv is None else (q, kv, kv)
+        out = attn.apply(
+            params, x, key=k_attn, train=train, attn_mask=mask,
+            key_padding_mask=padding_mask, is_causal=is_causal,
+        )
+        return _keyed_dropout(out, self.dropout_p, k_drop, train)
+
+    def apply(self, params, tgt, memory=None, *, key=None, train=False,
+              tgt_mask=None, memory_mask=None, tgt_key_padding_mask=None,
+              memory_key_padding_mask=None, tgt_is_causal: bool = False,
+              memory_is_causal: bool = False):
+        if memory is None:
+            raise ValueError("TransformerDecoderLayer needs the encoder memory")
+        k_sa, k_ca, k_ff = (
+            jax.random.split(key, 3) if key is not None else (None, None, None)
+        )
+        norm = lambda i, v: getattr(self, f"norm{i}").apply(params[f"norm{i}"], v)
+        sa = lambda v, k: self._attn_block(
+            self.self_attn, params["self_attn"], v, None, k, train, tgt_mask,
+            tgt_key_padding_mask, tgt_is_causal,
+        )
+        ca = lambda v, k: self._attn_block(
+            self.multihead_attn, params["multihead_attn"], v, memory, k, train,
+            memory_mask, memory_key_padding_mask, memory_is_causal,
+        )
+        x = tgt
+        if self.norm_first:
+            x = x + sa(norm(1, x), k_sa)
+            x = x + ca(norm(2, x), k_ca)
+            x = x + self._ff_block(params, norm(3, x), k_ff, train)
+        else:
+            x = norm(1, x + sa(x, k_sa))
+            x = norm(2, x + ca(x, k_ca))
+            x = norm(3, x + self._ff_block(params, x, k_ff, train))
+        return x
+
+    def __call__(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                 tgt_key_padding_mask=None, memory_key_padding_mask=None,
+                 tgt_is_causal: bool = False, memory_is_causal: bool = False,
+                 *, key=None, train=None):
+        key, train = self._resolve_ctx(key, train)
+        return self.apply(
+            self.params, tgt, memory, key=key, train=train, tgt_mask=tgt_mask,
+            memory_mask=memory_mask, tgt_key_padding_mask=tgt_key_padding_mask,
+            memory_key_padding_mask=memory_key_padding_mask,
+            tgt_is_causal=tgt_is_causal, memory_is_causal=memory_is_causal,
+        )
+
+
+class TransformerDecoder(_LayerStack):
+    """torch.nn.TransformerDecoder: N fresh-parameter copies of a decoder layer
+    plus an optional final norm."""
+
+    def apply(self, params, tgt, memory=None, *, key=None, train=False,
+              **mask_kwargs):
+        return self._run_stack(
+            params, tgt, key, train,
+            lambda layer, p, x, k: layer.apply(
+                p, x, memory, key=k, train=train, **mask_kwargs
+            ),
+        )
+
+    def __call__(self, tgt, memory, *, key=None, train=None, **mask_kwargs):
+        key, train = self._resolve_ctx(key, train)
+        return self.apply(self.params, tgt, memory, key=key, train=train,
+                          **mask_kwargs)

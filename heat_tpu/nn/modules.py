@@ -154,7 +154,10 @@ class Module:
     def eval(self) -> "Module":
         return self.train(False)
 
-    def __call__(self, x, *, key=None, train: Optional[bool] = None):
+    def _resolve_ctx(self, key=None, train: Optional[bool] = None):
+        """Resolve the PRNG key / train flag for a stateful-veneer call: explicit
+        arguments win, then the ``_ctx`` a parent ``apply`` bound, then the
+        ``.train()``/``.eval()`` mode, defaulting to eval."""
         ctx = getattr(self, "_ctx", None)
         if ctx is not None:
             if key is None:
@@ -163,6 +166,10 @@ class Module:
                 train = ctx[1]
         if train is None:
             train = getattr(self, "_train_mode", False)
+        return key, train
+
+    def __call__(self, x, *, key=None, train: Optional[bool] = None):
+        key, train = self._resolve_ctx(key, train)
         value = self.apply(self.params, _to_value(x), key=key, train=train)
         if isinstance(x, DNDarray) and not isinstance(value, DNDarray):
             from ..core._operations import wrap_result

@@ -608,3 +608,98 @@ class TestTransformerEncoder:
         out_s = hl.apply(hl.params, xs)
         assert out_s.split == 1
         np.testing.assert_allclose(out_s.numpy(), base, rtol=2e-5, atol=2e-5)
+
+
+class TestTransformerDecoder:
+    @staticmethod
+    def _map_attn(sd, prefix):
+        return {
+            "in_proj_weight": jnp.asarray(sd[f"{prefix}.in_proj_weight"].numpy()),
+            "in_proj_bias": jnp.asarray(sd[f"{prefix}.in_proj_bias"].numpy()),
+            "out_proj_weight": jnp.asarray(sd[f"{prefix}.out_proj.weight"].numpy()),
+            "out_proj_bias": jnp.asarray(sd[f"{prefix}.out_proj.bias"].numpy()),
+        }
+
+    @classmethod
+    def _map_params(cls, hm_params, t_layer):
+        sd = t_layer.state_dict()
+        p = dict(hm_params)
+        p["self_attn"] = cls._map_attn(sd, "self_attn")
+        p["multihead_attn"] = cls._map_attn(sd, "multihead_attn")
+        for name in ("linear1", "linear2"):
+            p[name] = {
+                "weight": jnp.asarray(sd[f"{name}.weight"].numpy()).T,
+                "bias": jnp.asarray(sd[f"{name}.bias"].numpy()),
+            }
+        for name in ("norm1", "norm2", "norm3"):
+            p[name] = {
+                "weight": jnp.asarray(sd[f"{name}.weight"].numpy()),
+                "bias": jnp.asarray(sd[f"{name}.bias"].numpy()),
+            }
+        return p
+
+    @pytest.mark.parametrize("norm_first", [False, True])
+    def test_decoder_layer_torch_parity(self, norm_first):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(50)
+        B, Tt, Tm, E, H, FF = 2, 5, 7, 8, 2, 16
+        tgt = rng.standard_normal((B, Tt, E)).astype(np.float32)
+        mem = rng.standard_normal((B, Tm, E)).astype(np.float32)
+        tl = torch.nn.TransformerDecoderLayer(
+            E, H, dim_feedforward=FF, dropout=0.0, batch_first=True,
+            norm_first=norm_first,
+        ).eval()
+        hl = ht.nn.TransformerDecoderLayer(
+            E, H, dim_feedforward=FF, dropout=0.0, norm_first=norm_first
+        )
+        params = self._map_params(hl.params, tl)
+        want = tl(torch.tensor(tgt), torch.tensor(mem)).detach().numpy()
+        got = np.asarray(hl.apply(params, jnp.asarray(tgt), jnp.asarray(mem)))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+        # causal target self-attention + a memory key-padding mask
+        mkpm = np.zeros((B, Tm), bool)
+        mkpm[0, 5:] = True
+        want_c = tl(
+            torch.tensor(tgt), torch.tensor(mem),
+            tgt_mask=torch.nn.Transformer.generate_square_subsequent_mask(Tt),
+            tgt_is_causal=True,
+            memory_key_padding_mask=torch.tensor(mkpm),
+        ).detach().numpy()
+        got_c = np.asarray(hl.apply(
+            params, jnp.asarray(tgt), jnp.asarray(mem), tgt_is_causal=True,
+            memory_key_padding_mask=jnp.asarray(mkpm),
+        ))
+        np.testing.assert_allclose(got_c, want_c, rtol=2e-5, atol=2e-5)
+
+    def test_decoder_stack_torch_parity(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(51)
+        B, Tt, Tm, E, H, FF, N = 2, 4, 6, 8, 2, 12, 2
+        tgt = rng.standard_normal((B, Tt, E)).astype(np.float32)
+        mem = rng.standard_normal((B, Tm, E)).astype(np.float32)
+        tl = torch.nn.TransformerDecoderLayer(
+            E, H, dim_feedforward=FF, dropout=0.0, batch_first=True
+        )
+        tdec = torch.nn.TransformerDecoder(tl, N, norm=torch.nn.LayerNorm(E)).eval()
+        hdec = ht.nn.TransformerDecoder(
+            ht.nn.TransformerDecoderLayer(E, H, dim_feedforward=FF, dropout=0.0),
+            N, norm=ht.nn.LayerNorm(E),
+        )
+        params = dict(hdec.params)
+        for i, t_layer in enumerate(tdec.layers):
+            params[str(i)] = self._map_params(params[str(i)], t_layer)
+        nsd = tdec.norm.state_dict()
+        params["norm"] = {
+            "weight": jnp.asarray(nsd["weight"].numpy()),
+            "bias": jnp.asarray(nsd["bias"].numpy()),
+        }
+        want = tdec(torch.tensor(tgt), torch.tensor(mem)).detach().numpy()
+        got = np.asarray(hdec.apply(params, jnp.asarray(tgt), jnp.asarray(mem)))
+        np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+        # torch-style __call__ matches, and dropout demands a key in train mode
+        got2, = (np.asarray(hdec(jnp.asarray(tgt), jnp.asarray(mem))),)
+        # fresh params in the stateful path -> only check shape/determinism
+        assert got2.shape == want.shape
+        hd = ht.nn.TransformerDecoderLayer(E, H, dropout=0.4)
+        with pytest.raises(ValueError):
+            hd.apply(hd.params, jnp.asarray(tgt), jnp.asarray(mem), train=True)
