@@ -53,6 +53,7 @@ __all__ = [
     "TransformerEncoder",
     "TransformerDecoderLayer",
     "TransformerDecoder",
+    "Transformer",
 ]
 
 _NEG_INF = float(np.finfo(np.float32).min)
@@ -704,7 +705,7 @@ class _LayerStack(Module):
             params["norm"] = self.norm.init(ks[-1])
         return params
 
-    def _run_stack(self, params, x, key, train, call):
+    def _run_stack(self, params, x, key, call):
         """Thread x through the layers (per-layer key split), then the final norm.
         ``call(layer, layer_params, x, k)`` runs one layer."""
         ks = (
@@ -799,10 +800,14 @@ class TransformerEncoder(_LayerStack):
     encoder layer (same hyperparameters, fresh params per layer), plus an
     optional final norm."""
 
+    def __init__(self, encoder_layer: TransformerEncoderLayer, num_layers: int,
+                 norm=None):
+        super().__init__(encoder_layer, num_layers, norm)
+
     def apply(self, params, src, *, key=None, train=False, src_mask=None,
               src_key_padding_mask=None, is_causal: bool = False):
         return self._run_stack(
-            params, src, key, train,
+            params, src, key,
             lambda layer, p, x, k: layer.apply(
                 p, x, key=k, train=train, src_mask=src_mask,
                 src_key_padding_mask=src_key_padding_mask, is_causal=is_causal,
@@ -918,10 +923,14 @@ class TransformerDecoder(_LayerStack):
     """torch.nn.TransformerDecoder: N fresh-parameter copies of a decoder layer
     plus an optional final norm."""
 
+    def __init__(self, decoder_layer: TransformerDecoderLayer, num_layers: int,
+                 norm=None):
+        super().__init__(decoder_layer, num_layers, norm)
+
     def apply(self, params, tgt, memory=None, *, key=None, train=False,
               **mask_kwargs):
         return self._run_stack(
-            params, tgt, key, train,
+            params, tgt, key,
             lambda layer, p, x, k: layer.apply(
                 p, x, memory, key=k, train=train, **mask_kwargs
             ),
@@ -931,3 +940,78 @@ class TransformerDecoder(_LayerStack):
         key, train = self._resolve_ctx(key, train)
         return self.apply(self.params, tgt, memory, key=key, train=train,
                           **mask_kwargs)
+
+
+class Transformer(Module):
+    """torch.nn.Transformer semantics: an encoder-decoder pair sharing one set of
+    hyperparameters, plus the ``generate_square_subsequent_mask`` helper.
+
+    ``forward(src, tgt)`` runs ``decoder(tgt, encoder(src))``; all the usual mask
+    and padding arguments pass through. ``batch_first`` defaults True (the
+    TPU-natural layout — see the deviations page)."""
+
+    def __init__(self, d_model: int = 512, nhead: int = 8,
+                 num_encoder_layers: int = 6, num_decoder_layers: int = 6,
+                 dim_feedforward: int = 2048, dropout: float = 0.1,
+                 activation="relu", layer_norm_eps: float = 1e-5,
+                 batch_first: bool = True, norm_first: bool = False,
+                 bias: bool = True):
+        from .modules import LayerNorm
+
+        self.d_model = d_model
+        self.nhead = nhead
+        self.batch_first = batch_first
+        self.encoder = TransformerEncoder(
+            TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                layer_norm_eps, batch_first, norm_first, bias,
+            ),
+            num_encoder_layers,
+            norm=LayerNorm(d_model, eps=layer_norm_eps),
+        )
+        self.decoder = TransformerDecoder(
+            TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                layer_norm_eps, batch_first, norm_first, bias,
+            ),
+            num_decoder_layers,
+            norm=LayerNorm(d_model, eps=layer_norm_eps),
+        )
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"encoder": self.encoder.init(k1), "decoder": self.decoder.init(k2)}
+
+    def apply(self, params, src, tgt=None, *, key=None, train=False,
+              src_mask=None, tgt_mask=None, memory_mask=None,
+              src_key_padding_mask=None, tgt_key_padding_mask=None,
+              memory_key_padding_mask=None, src_is_causal: bool = False,
+              tgt_is_causal: bool = False, memory_is_causal: bool = False):
+        if tgt is None:
+            raise ValueError("Transformer needs both src and tgt")
+        k1, k2 = jax.random.split(key) if key is not None else (None, None)
+        memory = self.encoder.apply(
+            params["encoder"], src, key=k1, train=train, src_mask=src_mask,
+            src_key_padding_mask=src_key_padding_mask, is_causal=src_is_causal,
+        )
+        return self.decoder.apply(
+            params["decoder"], tgt, memory, key=k2, train=train,
+            tgt_mask=tgt_mask, memory_mask=memory_mask,
+            tgt_key_padding_mask=tgt_key_padding_mask,
+            memory_key_padding_mask=memory_key_padding_mask,
+            tgt_is_causal=tgt_is_causal, memory_is_causal=memory_is_causal,
+        )
+
+    def __call__(self, src, tgt, *, key=None, train=None, **mask_kwargs):
+        key, train = self._resolve_ctx(key, train)
+        return self.apply(self.params, src, tgt, key=key, train=train,
+                          **mask_kwargs)
+
+    @staticmethod
+    def generate_square_subsequent_mask(sz: int):
+        """(sz, sz) additive f32 mask: 0 on/below the diagonal, -inf above —
+        torch's causal-mask helper, usable as ``attn_mask``/``tgt_mask``."""
+        return jnp.where(
+            jnp.arange(sz)[:, None] >= jnp.arange(sz)[None, :],
+            jnp.float32(0), jnp.float32(-jnp.inf),
+        )

@@ -703,3 +703,49 @@ class TestTransformerDecoder:
         hd = ht.nn.TransformerDecoderLayer(E, H, dropout=0.4)
         with pytest.raises(ValueError):
             hd.apply(hd.params, jnp.asarray(tgt), jnp.asarray(mem), train=True)
+
+
+class TestTransformer:
+    def test_transformer_torch_parity(self):
+        """Full encoder-decoder wrapper vs torch.nn.Transformer with mapped
+        weights, plus the causal-mask helper."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(60)
+        B, Ts, Tt, E, H, FF, N = 2, 6, 4, 8, 2, 16, 2
+        src = rng.standard_normal((B, Ts, E)).astype(np.float32)
+        tgt = rng.standard_normal((B, Tt, E)).astype(np.float32)
+        tm = torch.nn.Transformer(
+            d_model=E, nhead=H, num_encoder_layers=N, num_decoder_layers=N,
+            dim_feedforward=FF, dropout=0.0, batch_first=True,
+        ).eval()
+        hm = ht.nn.Transformer(
+            d_model=E, nhead=H, num_encoder_layers=N, num_decoder_layers=N,
+            dim_feedforward=FF, dropout=0.0,
+        )
+        params = dict(hm.params)
+        enc_p = dict(params["encoder"])
+        for i, t_layer in enumerate(tm.encoder.layers):
+            enc_p[str(i)] = TestTransformerEncoder._map_params(enc_p[str(i)], t_layer)
+        nsd = tm.encoder.norm.state_dict()
+        enc_p["norm"] = {"weight": jnp.asarray(nsd["weight"].numpy()),
+                         "bias": jnp.asarray(nsd["bias"].numpy())}
+        dec_p = dict(params["decoder"])
+        for i, t_layer in enumerate(tm.decoder.layers):
+            dec_p[str(i)] = TestTransformerDecoder._map_params(dec_p[str(i)], t_layer)
+        nsd = tm.decoder.norm.state_dict()
+        dec_p["norm"] = {"weight": jnp.asarray(nsd["weight"].numpy()),
+                         "bias": jnp.asarray(nsd["bias"].numpy())}
+        params = {"encoder": enc_p, "decoder": dec_p}
+
+        t_mask = torch.nn.Transformer.generate_square_subsequent_mask(Tt)
+        h_mask = ht.nn.Transformer.generate_square_subsequent_mask(Tt)
+        np.testing.assert_array_equal(np.asarray(h_mask), t_mask.numpy())
+        want = tm(torch.tensor(src), torch.tensor(tgt),
+                  tgt_mask=t_mask).detach().numpy()
+        got = np.asarray(hm.apply(params, jnp.asarray(src), jnp.asarray(tgt),
+                                  tgt_mask=h_mask))
+        np.testing.assert_allclose(got, want, rtol=5e-5, atol=5e-5)
+        # __call__ path with explicit params installed
+        hm.params = params
+        got2 = np.asarray(hm(jnp.asarray(src), jnp.asarray(tgt), tgt_mask=h_mask))
+        np.testing.assert_array_equal(got2, got)
