@@ -1,0 +1,106 @@
+"""Sequence-to-sequence with ``ht.nn.Transformer``: learn to reverse a token
+sequence.
+
+Demonstrates the full torch-parity encoder-decoder stack (reference reaches it
+through its torch fall-through, ``nn/__init__.py:18-31``) driven as a pure
+jax program: ``init`` once, ``jax.value_and_grad`` over ``apply``, optax updates
+— the whole training step is ONE jitted XLA program, causal target masking via
+``Transformer.generate_square_subsequent_mask``.
+
+Run:  python examples/nn/seq2seq_transformer.py   (~200 steps, loss < 0.1 nats)
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import heat_tpu as ht
+
+VOCAB, T, E, H, LAYERS = 16, 10, 32, 4, 2
+BOS = 0
+
+
+class Seq2Seq(ht.nn.Module):
+    def __init__(self):
+        self.embed = ht.nn.Embedding(VOCAB, E)
+        self.pos = ht.nn.Embedding(T + 1, E)
+        self.core = ht.nn.Transformer(
+            d_model=E, nhead=H, num_encoder_layers=LAYERS,
+            num_decoder_layers=LAYERS, dim_feedforward=4 * E, dropout=0.0,
+        )
+        self.out = ht.nn.Linear(E, VOCAB)
+
+    def init(self, key):
+        ks = jax.random.split(key, 4)
+        return {
+            "embed": self.embed.init(ks[0]),
+            "pos": self.pos.init(ks[1]),
+            "core": self.core.init(ks[2]),
+            "out": self.out.init(ks[3]),
+        }
+
+    def apply(self, params, src, tgt_in, *, key=None, train=False):
+        pos_s = jnp.arange(src.shape[1])
+        pos_t = jnp.arange(tgt_in.shape[1])
+        se = self.embed.apply(params["embed"], src) + self.pos.apply(params["pos"], pos_s)
+        te = self.embed.apply(params["embed"], tgt_in) + self.pos.apply(params["pos"], pos_t)
+        mask = ht.nn.Transformer.generate_square_subsequent_mask(tgt_in.shape[1])
+        h = self.core.apply(params["core"], se, te, key=key, train=train,
+                            tgt_mask=mask)
+        return self.out.apply(params["out"], h)
+
+
+def batch(key, n=64):
+    src = jax.random.randint(key, (n, T), 1, VOCAB)
+    tgt = src[:, ::-1]
+    tgt_in = jnp.concatenate([jnp.full((n, 1), BOS), tgt[:, :-1]], axis=1)
+    return src, tgt_in, tgt
+
+
+def main(steps: int = 200):
+    model = Seq2Seq()
+    params = model.init(jax.random.key(0))
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+    crit = ht.nn.CrossEntropyLoss()
+
+    def loss_fn(p, src, tgt_in, tgt):
+        logits = model.apply(p, src, tgt_in)
+        return crit(logits.reshape(-1, VOCAB), tgt.reshape(-1))
+
+    @jax.jit
+    def step(p, s, key):
+        src, tgt_in, tgt = batch(key)
+        loss, g = jax.value_and_grad(loss_fn)(p, src, tgt_in, tgt)
+        updates, s = opt.update(g, s)
+        return optax.apply_updates(p, updates), s, loss
+
+    loss = None
+    for i in range(steps):
+        params, opt_state, loss = step(params, opt_state, jax.random.key(i))
+        if i % 50 == 0:
+            print(f"step {i:4d}  loss {float(loss):.4f}")
+    print(f"final loss {float(loss):.4f}")
+
+    # greedy decode one example
+    src, tgt_in, tgt = batch(jax.random.key(999), n=1)
+    dec = jnp.full((1, 1), BOS)
+    for _ in range(T):
+        logits = model.apply(params, src, dec)
+        nxt = jnp.argmax(logits[:, -1:, :], axis=-1)
+        dec = jnp.concatenate([dec, nxt], axis=1)
+    print("src     :", np.asarray(src)[0].tolist())
+    print("decoded :", np.asarray(dec)[0, 1:].tolist())
+    print("target  :", np.asarray(tgt)[0].tolist())
+    return float(loss)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,6 +7,9 @@ Demonstrates the long-context machinery end-to-end:
   identical math runs as ring attention over the mesh (context parallelism).
 - torch-style ``Module`` authoring (attribute submodules + ``forward``), the
   same UX the reference's MNIST example uses (`examples/nn/mnist.py:23-45`).
+  The hand-rolled ``Block`` below is a pre-norm transformer layer; the packaged
+  equivalent is ``ht.nn.TransformerEncoderLayer(..., norm_first=True)`` /
+  ``ht.nn.TransformerEncoder`` (torch-parity signatures).
 
 Run:  python examples/nn/transformer_lm.py  (a few hundred steps on a toy
 corpus; reaches < 1.0 nats next-char loss in ~30 s on one chip).
