@@ -22,7 +22,7 @@ from jax import lax
 
 from .modules import Module
 
-__all__ = ["RNN", "LSTM", "GRU"]
+__all__ = ["RNN", "LSTM", "GRU", "RNNCell", "LSTMCell", "GRUCell"]
 
 
 class _RNNBase(Module):
@@ -193,3 +193,78 @@ class GRU(_RNNBase):
         n = jnp.tanh(i_n + r * h_n)
         h_new = (1.0 - z) * n + z * h
         return h_new, h_new
+
+
+class _CellBase(Module):
+    """Single-step recurrent cell (torch.nn.*Cell semantics): flat torch param
+    names (``weight_ih``/``weight_hh``/``bias_ih``/``bias_hh``), batched (B, I)
+    or unbatched (I,) input, state defaults to zeros. The gate math is the
+    corresponding full module's ``_cell`` — one implementation, two surfaces."""
+
+    CORE = None  # RNN / LSTM / GRU
+
+    def __init__(self, input_size: int, hidden_size: int, bias: bool = True,
+                 **core_kwargs):
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.bias = bias
+        self._core = type(self).CORE(
+            input_size, hidden_size, num_layers=1, bias=bias, **core_kwargs
+        )
+
+    def named_submodules(self):
+        return []  # _core is an implementation detail, not a parameterised child
+
+    def init(self, key):
+        return {k[: -len("_l0")]: v for k, v in self._core.init(key).items()}
+
+    def apply(self, params, x, state=None, *, key=None, train=False):
+        p = {f"{k}_l0": v for k, v in params.items()}
+        squeeze = x.ndim == 1
+        if squeeze:
+            x = x[None]
+            if state is not None:
+                state = jax.tree.map(lambda s: s[None], state)
+        if state is None:
+            dtype = jnp.result_type(x.dtype, jnp.float32)
+            state = self._core._zero_state(x.shape[0], dtype)
+        new_state, _ = self._core._cell(p, 0, x, state)
+        if squeeze:
+            new_state = jax.tree.map(lambda s: s[0], new_state)
+        return new_state
+
+    def __call__(self, x, state=None):
+        from .modules import _to_value
+        from ..core.dndarray import DNDarray
+
+        value = _to_value(x)
+        state = jax.tree.map(_to_value, state) if state is not None else None
+        out = self.apply(self.params, value, state)
+        if isinstance(x, DNDarray):
+            from ..core._operations import wrap_result
+
+            # state rows follow the input's batch split (feature dim is new)
+            keep = x.split if x.split == 0 and x.ndim == 2 else None
+            out = jax.tree.map(lambda s: wrap_result(s, x, keep), out)
+        return out
+
+
+class RNNCell(_CellBase):
+    """torch.nn.RNNCell: h' = tanh/relu(W_ih x + b_ih + W_hh h + b_hh)."""
+
+    CORE = RNN
+
+    def __init__(self, input_size, hidden_size, bias=True, nonlinearity="tanh"):
+        super().__init__(input_size, hidden_size, bias, nonlinearity=nonlinearity)
+
+
+class LSTMCell(_CellBase):
+    """torch.nn.LSTMCell: (h', c') from (x, (h, c)); gate order i, f, g, o."""
+
+    CORE = LSTM
+
+
+class GRUCell(_CellBase):
+    """torch.nn.GRUCell: torch's r, z, n gate formulation."""
+
+    CORE = GRU

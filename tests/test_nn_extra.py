@@ -427,3 +427,85 @@ class TestReviewRegressions:
 
         q = jnp.zeros((1, 1, 1024, 64), jnp.float64)
         assert not use_flash(q, q, q, None, interpret=True)
+
+
+class TestRecurrentCells:
+    """torch.nn.RNNCell/LSTMCell/GRUCell parity: same weights -> same step."""
+
+    @pytest.mark.parametrize("kind", ["RNNCell", "LSTMCell", "GRUCell"])
+    def test_cell_torch_parity(self, kind):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(70)
+        B, I, H = 3, 5, 7
+        t_cell = getattr(torch.nn, kind)(I, H)
+        h_cell = getattr(ht.nn, kind)(I, H)
+        params = {
+            name: jnp.asarray(getattr(t_cell, name).detach().numpy())
+            for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh")
+        }
+        x = rng.standard_normal((B, I)).astype(np.float32)
+        h0 = rng.standard_normal((B, H)).astype(np.float32)
+        if kind == "LSTMCell":
+            c0 = rng.standard_normal((B, H)).astype(np.float32)
+            want_h, want_c = t_cell(torch.tensor(x), (torch.tensor(h0), torch.tensor(c0)))
+            got_h, got_c = h_cell.apply(params, jnp.asarray(x),
+                                        (jnp.asarray(h0), jnp.asarray(c0)))
+            np.testing.assert_allclose(np.asarray(got_h), want_h.detach().numpy(),
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(got_c), want_c.detach().numpy(),
+                                       rtol=1e-5, atol=1e-5)
+            # default zero state, and the unbatched (I,) form
+            got0 = h_cell.apply(params, jnp.asarray(x))
+            want0 = t_cell(torch.tensor(x))
+            np.testing.assert_allclose(np.asarray(got0[0]), want0[0].detach().numpy(),
+                                       rtol=1e-5, atol=1e-5)
+            gu = h_cell.apply(params, jnp.asarray(x[0]),
+                              (jnp.asarray(h0[0]), jnp.asarray(c0[0])))
+            assert gu[0].shape == (H,)
+            np.testing.assert_allclose(np.asarray(gu[0]), np.asarray(got_h)[0],
+                                       rtol=1e-6, atol=1e-6)
+        else:
+            want = t_cell(torch.tensor(x), torch.tensor(h0))
+            got = h_cell.apply(params, jnp.asarray(x), jnp.asarray(h0))
+            np.testing.assert_allclose(np.asarray(got), want.detach().numpy(),
+                                       rtol=1e-5, atol=1e-5)
+            got0 = h_cell.apply(params, jnp.asarray(x))
+            want0 = t_cell(torch.tensor(x))
+            np.testing.assert_allclose(np.asarray(got0), want0.detach().numpy(),
+                                       rtol=1e-5, atol=1e-5)
+            gu = h_cell.apply(params, jnp.asarray(x[0]), jnp.asarray(h0[0]))
+            assert gu.shape == (H,)
+            np.testing.assert_allclose(np.asarray(gu), np.asarray(got)[0],
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_rnncell_relu_and_stateful(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(71)
+        B, I, H = 2, 4, 3
+        t_cell = torch.nn.RNNCell(I, H, nonlinearity="relu")
+        h_cell = ht.nn.RNNCell(I, H, nonlinearity="relu")
+        h_cell.params = {
+            name: jnp.asarray(getattr(t_cell, name).detach().numpy())
+            for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh")
+        }
+        x = rng.standard_normal((B, I)).astype(np.float32)
+        h0 = rng.standard_normal((B, H)).astype(np.float32)
+        got = h_cell(jnp.asarray(x), jnp.asarray(h0))  # stateful veneer
+        want = t_cell(torch.tensor(x), torch.tensor(h0))
+        np.testing.assert_allclose(np.asarray(got), want.detach().numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_cell_dndarray_input(self):
+        """Cells accept DNDarray input like every other layer; batch split kept."""
+        rng = np.random.default_rng(72)
+        B, I, H = 4, 5, 3
+        cell = ht.nn.GRUCell(I, H)
+        x = rng.standard_normal((B, I)).astype(np.float32)
+        want = np.asarray(cell(jnp.asarray(x)))
+        got = cell(ht.array(x, split=0))
+        assert isinstance(got, ht.DNDarray) and got.split == 0
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-6, atol=1e-6)
+        # LSTM cell returns a (h, c) tree of DNDarrays
+        lc = ht.nn.LSTMCell(I, H)
+        h, c = lc(ht.array(x, split=0))
+        assert isinstance(h, ht.DNDarray) and isinstance(c, ht.DNDarray)
