@@ -29,8 +29,11 @@ __all__ = [
     "softmax",
     "log_softmax",
     "linear",
+    "conv1d",
     "conv2d",
+    "max_pool1d",
     "max_pool2d",
+    "avg_pool1d",
     "avg_pool2d",
     "dropout",
     "dropout2d",
@@ -66,6 +69,18 @@ from .attention import scaled_dot_product_attention  # noqa: E402
 
 def _pair(v) -> Tuple[int, int]:
     return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _str_padding(padding: str, strides):
+    """torch's conv padding strings: 'valid' = no padding; 'same' = output keeps
+    the input's spatial extent (torch requires stride 1 for 'same')."""
+    if padding == "valid":
+        return "VALID"
+    if padding == "same":
+        if any(s != 1 for s in strides):
+            raise ValueError("padding='same' requires stride 1 (torch semantics)")
+        return "SAME"
+    raise ValueError(f"padding must be an int, a tuple, 'same' or 'valid', got {padding!r}")
 
 
 def _unwrap(x):
@@ -155,19 +170,89 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups: int = 
     v, proto = _unwrap(x)
     weight, bias = _p(weight), _p(bias)
     sh, sw = _pair(stride)
-    ph, pw = _pair(padding)
     dh, dw = _pair(dilation)
+    if isinstance(padding, str):
+        pad = _str_padding(padding, (sh, sw))
+    else:
+        ph, pw = _pair(padding)
+        pad = ((ph, ph), (pw, pw))
     out = jax.lax.conv_general_dilated(
         v,
         weight.astype(v.dtype),
         window_strides=(sh, sw),
-        padding=((ph, ph), (pw, pw)),
+        padding=pad,
         rhs_dilation=(dh, dw),
         feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
     )
     if bias is not None:
         out = out + bias.astype(out.dtype).reshape(1, -1, 1, 1)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups: int = 1):
+    """1-D convolution, torch semantics: x (N,C,L), weight (O, C/groups, k).
+
+    The distributed-along-L counterpart is :func:`heat_tpu.signal.convolve`
+    (reference ``core/signal.py``); this is the local nn-layer op."""
+    v, proto = _unwrap(x)
+    weight, bias = _p(weight), _p(bias)
+    s = stride if isinstance(stride, int) else stride[0]
+    d = dilation if isinstance(dilation, int) else dilation[0]
+    if isinstance(padding, str):
+        pad = _str_padding(padding, (s,))
+    else:
+        p = padding if isinstance(padding, int) else padding[0]
+        pad = ((p, p),)
+    out = jax.lax.conv_general_dilated(
+        v,
+        weight.astype(v.dtype),
+        window_strides=(s,),
+        padding=pad,
+        rhs_dilation=(d,),
+        feature_group_count=groups,
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+    if bias is not None:
+        out = out + bias.astype(out.dtype).reshape(1, -1, 1)
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    """Max pooling over the trailing length dim (torch semantics)."""
+    v, proto = _unwrap(x)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = (stride if isinstance(stride, int) else stride[0]) if stride is not None else k
+    p = padding if isinstance(padding, int) else padding[0]
+    out = jax.lax.reduce_window(
+        v,
+        -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min,
+        jax.lax.max,
+        window_dimensions=(1, 1, k),
+        window_strides=(1, 1, s),
+        padding=((0, 0), (0, 0), (p, p)),
+    )
+    return _rewrap(out, proto) if proto is not None else out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0):
+    """Average pooling over the trailing length dim (torch semantics: zero-padded
+    positions count toward the divisor).
+
+    Implemented as a depthwise all-ones convolution rather than
+    ``lax.reduce_window(add)``: this jax build cannot reverse-differentiate
+    windowed sums under jit ("Linearization failed to produce known values"),
+    while conv grads are solid — and the MXU likes convs anyway."""
+    v, proto = _unwrap(x)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = (stride if isinstance(stride, int) else stride[0]) if stride is not None else k
+    p = padding if isinstance(padding, int) else padding[0]
+    c = v.shape[1]
+    ones = jnp.ones((c, 1, k), v.dtype)
+    out = jax.lax.conv_general_dilated(
+        v, ones, window_strides=(s,), padding=((p, p),),
+        feature_group_count=c, dimension_numbers=("NCH", "OIH", "NCH"),
+    ) / k
     return _rewrap(out, proto) if proto is not None else out
 
 
@@ -190,18 +275,20 @@ def max_pool2d(x, kernel_size, stride=None, padding=0):
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0):
     """Average pooling over the two trailing spatial dims (torch semantics:
-    zero-padded positions count toward the divisor)."""
+    zero-padded positions count toward the divisor).
+
+    Depthwise all-ones convolution instead of ``lax.reduce_window(add)`` — see
+    :func:`avg_pool1d` for why (windowed sums don't reverse-differentiate under
+    jit on this jax build)."""
     v, proto = _unwrap(x)
     kh, kw = _pair(kernel_size)
     sh, sw = _pair(stride) if stride is not None else (kh, kw)
     ph, pw = _pair(padding)
-    out = jax.lax.reduce_window(
-        v,
-        jnp.zeros((), v.dtype),
-        jax.lax.add,
-        window_dimensions=(1, 1, kh, kw),
-        window_strides=(1, 1, sh, sw),
-        padding=((0, 0), (0, 0), (ph, ph), (pw, pw)),
+    c = v.shape[1]
+    ones = jnp.ones((c, 1, kh, kw), v.dtype)
+    out = jax.lax.conv_general_dilated(
+        v, ones, window_strides=(sh, sw), padding=((ph, ph), (pw, pw)),
+        feature_group_count=c, dimension_numbers=("NCHW", "OIHW", "NCHW"),
     ) / (kh * kw)
     return _rewrap(out, proto) if proto is not None else out
 

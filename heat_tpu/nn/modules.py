@@ -30,6 +30,9 @@ __all__ = [
     "AvgPool2d",
     "AdaptiveAvgPool2d",
     "AdaptiveMaxPool2d",
+    "Conv1d",
+    "MaxPool1d",
+    "AvgPool1d",
     "BatchNorm1d",
     "BatchNorm2d",
     "GroupNorm",
@@ -306,6 +309,71 @@ class AvgPool2d(Module):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding)
 
 
+class Conv1d(Module):
+    """1-D convolution, torch.nn.Conv1d semantics: input (N, C, L), weight
+    (out, in/groups, k), LeCun-style uniform init with bound 1/sqrt(fan_in)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 bias: bool = True):
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = (
+            kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        )
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.bias = bias
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        fan_in = self.in_channels // self.groups * self.kernel_size
+        bound = 1.0 / np.sqrt(fan_in)
+        w = jax.random.uniform(
+            k1,
+            (self.out_channels, self.in_channels // self.groups, self.kernel_size),
+            jnp.float32, -bound, bound,
+        )
+        if not self.bias:
+            return {"weight": w}
+        b = jax.random.uniform(k2, (self.out_channels,), jnp.float32, -bound, bound)
+        return {"weight": w, "bias": b}
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.conv1d(
+            x, params["weight"], params.get("bias"), self.stride, self.padding,
+            self.dilation, self.groups,
+        )
+
+
+class MaxPool1d(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding)
+
+
+class AvgPool1d(Module):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def apply(self, params, x, *, key=None, train=False):
+        from . import functional as F
+
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding)
+
+
 class _BatchNorm(Module):
     """Shared BatchNorm1d/2d machinery (torch semantics).
 
@@ -393,7 +461,9 @@ class LayerNorm(Module):
 
 class ReLU(Module):
     def apply(self, params, x, *, key=None, train=False):
-        return jnp.maximum(x, 0.0)
+        from . import functional as F
+
+        return F.relu(x)
 
 
 class LeakyReLU(Module):
@@ -401,7 +471,9 @@ class LeakyReLU(Module):
         self.negative_slope = negative_slope
 
     def apply(self, params, x, *, key=None, train=False):
-        return jax.nn.leaky_relu(x, self.negative_slope)
+        from . import functional as F
+
+        return F.leaky_relu(x, self.negative_slope)
 
 
 class GELU(Module):
@@ -414,7 +486,9 @@ class GELU(Module):
         self.approximate = approximate
 
     def apply(self, params, x, *, key=None, train=False):
-        return jax.nn.gelu(x, approximate=(self.approximate == "tanh"))
+        from . import functional as F
+
+        return F.gelu(x, approximate=self.approximate)
 
 
 class ELU(Module):
@@ -422,7 +496,9 @@ class ELU(Module):
         self.alpha = alpha
 
     def apply(self, params, x, *, key=None, train=False):
-        return jax.nn.elu(x, self.alpha)
+        from . import functional as F
+
+        return F.elu(x, self.alpha)
 
 
 class Softmax(Module):
@@ -430,7 +506,9 @@ class Softmax(Module):
         self.dim = dim
 
     def apply(self, params, x, *, key=None, train=False):
-        return jax.nn.softmax(x, axis=self.dim)
+        from . import functional as F
+
+        return F.softmax(x, dim=self.dim)
 
 
 class Identity(Module):
@@ -440,12 +518,16 @@ class Identity(Module):
 
 class Tanh(Module):
     def apply(self, params, x, *, key=None, train=False):
-        return jnp.tanh(x)
+        from . import functional as F
+
+        return F.tanh(x)
 
 
 class Sigmoid(Module):
     def apply(self, params, x, *, key=None, train=False):
-        return jax.nn.sigmoid(x)
+        from . import functional as F
+
+        return F.sigmoid(x)
 
 
 class LogSoftmax(Module):
@@ -453,12 +535,22 @@ class LogSoftmax(Module):
         self.dim = dim
 
     def apply(self, params, x, *, key=None, train=False):
-        return jax.nn.log_softmax(x, axis=self.dim)
+        from . import functional as F
+
+        return F.log_softmax(x, dim=self.dim)
 
 
 class Flatten(Module):
+    """torch.nn.Flatten: flatten dims [start_dim, end_dim] (defaults keep batch)."""
+
+    def __init__(self, start_dim: int = 1, end_dim: int = -1):
+        self.start_dim = start_dim
+        self.end_dim = end_dim
+
     def apply(self, params, x, *, key=None, train=False):
-        return x.reshape(x.shape[0], -1)
+        from . import functional as F
+
+        return F.flatten(x, self.start_dim, self.end_dim)
 
 
 class Dropout(Module):
@@ -470,8 +562,9 @@ class Dropout(Module):
             return x
         if key is None:
             raise ValueError("Dropout in train mode needs an explicit PRNG key")
-        keep = jax.random.bernoulli(key, 1.0 - self.p, x.shape)
-        return jnp.where(keep, x / (1.0 - self.p), 0.0)
+        from . import functional as F
+
+        return F.dropout(x, self.p, training=True, key=key)
 
 
 class Dropout2d(Module):
@@ -722,7 +815,9 @@ class InstanceNorm2d(Module):
 
 class ReLU6(Module):
     def apply(self, params, x, *, key=None, train=False):
-        return jnp.clip(x, 0.0, 6.0)
+        from . import functional as F
+
+        return F.hardtanh(x, 0.0, 6.0)
 
 
 class PReLU(Module):
@@ -739,17 +834,27 @@ class PReLU(Module):
         a = params["weight"]
         if self.num_parameters > 1 and x.ndim > 1:
             a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
-        return jnp.where(x >= 0, x, a * x)
+        v = _to_value(x)
+        out = jnp.where(v >= 0, v, a * v)
+        if isinstance(x, DNDarray):
+            from ..core._operations import wrap_result
+
+            return wrap_result(out, x, x.split)
+        return out
 
 
 class SiLU(Module):
     def apply(self, params, x, *, key=None, train=False):
-        return jax.nn.silu(x)
+        from . import functional as F
+
+        return F.silu(x)
 
 
 class Mish(Module):
     def apply(self, params, x, *, key=None, train=False):
-        return jax.nn.mish(x)
+        from . import functional as F
+
+        return F.mish(x)
 
 
 class Softplus(Module):
@@ -769,7 +874,9 @@ class Hardtanh(Module):
         self.max_val = max_val
 
     def apply(self, params, x, *, key=None, train=False):
-        return jnp.clip(x, self.min_val, self.max_val)
+        from . import functional as F
+
+        return F.hardtanh(x, self.min_val, self.max_val)
 
 
 class Unflatten(Module):
@@ -781,8 +888,15 @@ class Unflatten(Module):
 
     def apply(self, params, x, *, key=None, train=False):
         d = self.dim if self.dim >= 0 else x.ndim + self.dim
-        shape = x.shape[:d] + self.unflattened_size + x.shape[d + 1 :]
-        return x.reshape(shape)
+        shape = tuple(x.shape[:d]) + self.unflattened_size + tuple(x.shape[d + 1 :])
+        v = _to_value(x)
+        out = v.reshape(shape)
+        if isinstance(x, DNDarray):
+            from ..core._operations import wrap_result
+
+            keep = x.split if (x.split is not None and x.split < d) else None
+            return wrap_result(out, x, keep)
+        return out
 
 
 class ModuleList(Module):

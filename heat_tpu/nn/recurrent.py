@@ -233,7 +233,9 @@ class _CellBase(Module):
             new_state = jax.tree.map(lambda s: s[0], new_state)
         return new_state
 
-    def __call__(self, x, state=None):
+    def __call__(self, x, state=None, *, key=None, train=None):
+        # key/train accepted for the uniform Module veneer contract; cells are
+        # deterministic so both are ignored
         from .modules import _to_value
         from ..core.dndarray import DNDarray
 

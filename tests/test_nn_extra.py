@@ -509,3 +509,97 @@ class TestRecurrentCells:
         lc = ht.nn.LSTMCell(I, H)
         h, c = lc(ht.array(x, split=0))
         assert isinstance(h, ht.DNDarray) and isinstance(c, ht.DNDarray)
+
+
+class TestConv1dModules:
+    def test_conv1d_module_torch_parity(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(80)
+        n, c, L = 2, 3, 12
+        x = rng.standard_normal((n, c, L)).astype(np.float32)
+        tm = torch.nn.Conv1d(c, 5, 3, stride=2, padding=1)
+        hm = ht.nn.Conv1d(c, 5, 3, stride=2, padding=1)
+        hm.params = {
+            "weight": jnp.asarray(tm.weight.detach().numpy()),
+            "bias": jnp.asarray(tm.bias.detach().numpy()),
+        }
+        np.testing.assert_allclose(
+            np.asarray(hm(jnp.asarray(x))), tm(torch.tensor(x)).detach().numpy(),
+            rtol=1e-5, atol=1e-5,
+        )
+        # pipeline through the pool modules (torch parity)
+        seq = ht.nn.Sequential(hm, ht.nn.ReLU(), ht.nn.MaxPool1d(2))
+        tseq = torch.nn.Sequential(tm, torch.nn.ReLU(), torch.nn.MaxPool1d(2))
+        got = seq.apply([hm.params, (), ()], jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(got), tseq(torch.tensor(x)).detach().numpy(),
+            rtol=1e-5, atol=1e-5,
+        )
+        a = ht.nn.AvgPool1d(3, stride=1, padding=1)
+        ta = torch.nn.AvgPool1d(3, stride=1, padding=1)
+        np.testing.assert_allclose(
+            np.asarray(a(jnp.asarray(x))), ta(torch.tensor(x)).detach().numpy(),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+class TestAvgPoolJitGrad:
+    """Regression: jit(value_and_grad) through avg pooling. This jax build cannot
+    reverse-differentiate lax.reduce_window(add) under jit ('Linearization
+    failed to produce known values'), so avg pooling is a depthwise all-ones
+    conv; these lock the training path for both ranks."""
+
+    def test_avg_pool_grad_under_jit(self):
+        x1 = jnp.ones((4, 3, 16))
+        x2 = jnp.ones((4, 3, 8, 8))
+        g1 = jax.jit(jax.grad(lambda v: ht.nn.functional.avg_pool1d(v, 2).sum()))(x1)
+        g2 = jax.jit(jax.grad(lambda v: ht.nn.functional.avg_pool2d(v, 2).sum()))(x2)
+        # every input position contributes to exactly one window -> grad 1/k
+        np.testing.assert_allclose(np.asarray(g1), 0.5, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g2), 0.25, rtol=1e-6)
+
+    def test_conv_avgpool_train_step(self):
+        import optax
+
+        crit = ht.nn.CrossEntropyLoss()
+        m = ht.nn.Sequential(
+            ht.nn.Conv1d(1, 4, 3, padding=1), ht.nn.AvgPool1d(2),
+            ht.nn.Flatten(), ht.nn.Linear(4 * 8, 3),
+        )
+        p = m.init(jax.random.key(0))
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((8, 1, 16)).astype(np.float32))
+        y = jnp.zeros(8, jnp.int32)
+        opt = optax.adam(1e-2)
+        st = opt.init(p)
+
+        @jax.jit
+        def step(p, s):
+            l, g = jax.value_and_grad(lambda p: crit(m.apply(p, x), y))(p)
+            u, s = opt.update(g, s)
+            return optax.apply_updates(p, u), s, l
+
+        p2, st, l0 = step(p, st)
+        _, _, l1 = step(p2, st)
+        assert float(l1) < float(l0)
+
+    def test_conv_padding_strings_torch_parity(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(81)
+        x = rng.standard_normal((2, 3, 11)).astype(np.float32)
+        w = rng.standard_normal((4, 3, 3)).astype(np.float32)
+        x2 = rng.standard_normal((2, 3, 7, 9)).astype(np.float32)
+        w2 = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        F = ht.nn.functional
+        for pad in ("same", "valid"):
+            np.testing.assert_allclose(
+                np.asarray(F.conv1d(jnp.asarray(x), jnp.asarray(w), padding=pad)),
+                torch.nn.functional.conv1d(torch.tensor(x), torch.tensor(w), padding=pad).numpy(),
+                rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(
+                np.asarray(F.conv2d(jnp.asarray(x2), jnp.asarray(w2), padding=pad)),
+                torch.nn.functional.conv2d(torch.tensor(x2), torch.tensor(w2), padding=pad).numpy(),
+                rtol=1e-5, atol=1e-5)
+        with pytest.raises(ValueError):
+            F.conv1d(jnp.asarray(x), jnp.asarray(w), padding="same", stride=2)
+        with pytest.raises(ValueError):
+            F.conv2d(jnp.asarray(x2), jnp.asarray(w2), padding="reflect")

@@ -8,6 +8,8 @@ norm statistics, loss reductions, activations. Random shapes per numbered seed
 """
 
 import numpy as np
+
+import jax.numpy as jnp
 import pytest
 
 torch = pytest.importorskip("torch")
@@ -52,6 +54,41 @@ class TestConvPoolFuzz:
             stride=stride, padding=padding, dilation=dilation, groups=groups,
         )
         _chk(got, want, f"{case} g{groups} s{stride} p{padding} d{dilation}")
+
+    @pytest.mark.parametrize("case", range(N_CASES))
+    def test_conv1d_and_pool1d_geometry(self, case):
+        rng = np.random.default_rng(900 + case)
+        groups = int(rng.choice([1, 1, 2]))
+        cin = int(rng.integers(1, 4)) * groups
+        cout = int(rng.integers(1, 4)) * groups
+        k = int(rng.integers(1, 5))
+        stride = int(rng.integers(1, 3))
+        padding = int(rng.integers(0, 3))
+        dilation = int(rng.integers(1, 3))
+        L = int(rng.integers((k - 1) * dilation + 1, 20))
+        n = int(rng.integers(1, 4))
+        x = rng.standard_normal((n, cin, L)).astype(np.float32)
+        wgt = rng.standard_normal((cout, cin // groups, k)).astype(np.float32)
+        b = rng.standard_normal(cout).astype(np.float32)
+        got = F.conv1d(ht.array(x), ht.array(wgt), ht.array(b),
+                       stride=stride, padding=padding, dilation=dilation,
+                       groups=groups)
+        want = tF.conv1d(torch.tensor(x), torch.tensor(wgt), torch.tensor(b),
+                         stride=stride, padding=padding, dilation=dilation,
+                         groups=groups)
+        _chk(got, want, f"c1d {case} g{groups} s{stride} p{padding} d{dilation}")
+        # pools on the conv output geometry (torch caps padding at k//2)
+        pk = int(rng.integers(1, 4))
+        ps = int(rng.integers(1, 3))
+        pp = int(rng.integers(0, pk // 2 + 1))
+        Lo = int(want.shape[-1])
+        if Lo + 2 * pp >= pk:
+            got_m = F.max_pool1d(jnp.asarray(np.asarray(want.detach())), pk, ps, pp)
+            want_m = tF.max_pool1d(want.detach(), pk, ps, pp)
+            _chk(got_m, want_m, f"mp1d {case}")
+            got_a = F.avg_pool1d(jnp.asarray(np.asarray(want.detach())), pk, ps, pp)
+            want_a = tF.avg_pool1d(want.detach(), pk, ps, pp)
+            _chk(got_a, want_a, f"ap1d {case}")
 
     @pytest.mark.parametrize("case", range(N_CASES))
     def test_pools(self, case):
