@@ -55,6 +55,9 @@ __all__ = [
     "adaptive_avg_pool2d",
     "adaptive_max_pool2d",
     "pad",
+    "normalize",
+    "cosine_similarity",
+    "pairwise_distance",
     "binary_cross_entropy",
     "binary_cross_entropy_with_logits",
     "smooth_l1_loss",
@@ -554,6 +557,58 @@ def adaptive_max_pool2d(x, output_size):
     v, proto = _unwrap(x)
     out = _adaptive_pool2d(v, output_size, jnp.max)
     return _rewrap(out, proto) if proto is not None else out
+
+
+def normalize(x, p: float = 2.0, dim: int = 1, eps: float = 1e-12):
+    """torch.nn.functional.normalize: x / max(||x||_p, eps) along ``dim``."""
+    v, proto = _unwrap(x)
+    n = jnp.sum(jnp.abs(v) ** p, axis=dim, keepdims=True) ** (1.0 / p)
+    out = v / jnp.maximum(n, eps)
+    if proto is None:
+        return out
+    from ..core._operations import wrap_result
+
+    return wrap_result(out, proto, proto.split)
+
+
+def cosine_similarity(x1, x2, dim: int = 1, eps: float = 1e-8):
+    """torch.nn.functional.cosine_similarity (clamps each norm at eps)."""
+    v1, p1 = _unwrap(x1)
+    v2, p2 = _unwrap(x2)
+    n1 = jnp.maximum(jnp.linalg.norm(v1, axis=dim), eps)
+    n2 = jnp.maximum(jnp.linalg.norm(v2, axis=dim), eps)
+    out = jnp.sum(v1 * v2, axis=dim) / (n1 * n2)
+    proto = p1 if p1 is not None else p2
+    if proto is None:
+        return out
+    from ..core._operations import wrap_result
+
+    d = dim if dim >= 0 else proto.ndim + dim
+    # reduced-axis bookkeeping like every reduction: splits before d survive,
+    # splits after d shift down by one, the reduced axis itself replicates
+    keep = None
+    if proto.split is not None:
+        if proto.split < d:
+            keep = proto.split
+        elif proto.split > d:
+            keep = proto.split - 1
+    return wrap_result(out, proto, keep)
+
+
+def pairwise_distance(x1, x2, p: float = 2.0, eps: float = 1e-6,
+                      keepdim: bool = False):
+    """torch.nn.functional.pairwise_distance: ||x1 - x2 + eps||_p over the last dim."""
+    v1, p1 = _unwrap(x1)
+    v2, p2 = _unwrap(x2)
+    diff = jnp.abs(v1 - v2 + eps)
+    out = jnp.sum(diff ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    proto = p1 if p1 is not None else p2
+    if proto is None:
+        return out
+    from ..core._operations import wrap_result
+
+    keep = proto.split if (proto.split is not None and proto.split < proto.ndim - 1) else None
+    return wrap_result(out, proto, keep)
 
 
 def pad(x, pad_widths, mode: str = "constant", value: float = 0.0):

@@ -232,3 +232,49 @@ class TestNormLossFuzz:
         got = F.embedding(ht.array(idx), ht.array(wgt), padding_idx=pad_idx)
         want = tF.embedding(torch.tensor(idx), torch.tensor(wgt), padding_idx=pad_idx)
         _chk(got, want, case)
+
+
+class TestDistanceFunctionals:
+    @pytest.mark.parametrize("case", range(N_CASES))
+    def test_normalize_cosine_pairwise(self, case):
+        rng = np.random.default_rng(1200 + case)
+        B, D = int(rng.integers(1, 6)), int(rng.integers(1, 8))
+        x1 = rng.standard_normal((B, D)).astype(np.float32)
+        x2 = rng.standard_normal((B, D)).astype(np.float32)
+        p = float(rng.choice([1.0, 2.0, 3.0]))
+        dim = int(rng.choice([0, 1, -1]))
+        _chk(F.normalize(ht.array(x1), p=p, dim=dim),
+             tF.normalize(torch.tensor(x1), p=p, dim=dim), f"norm {case}")
+        _chk(F.cosine_similarity(ht.array(x1), ht.array(x2), dim=dim),
+             tF.cosine_similarity(torch.tensor(x1), torch.tensor(x2), dim=dim),
+             f"cos {case}")
+        _chk(F.pairwise_distance(ht.array(x1), ht.array(x2), p=p),
+             tF.pairwise_distance(torch.tensor(x1), torch.tensor(x2), p=p),
+             f"pdist {case}")
+        _chk(F.pairwise_distance(jnp.asarray(x1), jnp.asarray(x2), keepdim=True),
+             tF.pairwise_distance(torch.tensor(x1), torch.tensor(x2), keepdim=True),
+             f"pdist-k {case}")
+
+    def test_distance_functionals_sharded(self):
+        """Split bookkeeping: splits before the reduced dim survive, after it
+        shift down; normalize (shape-preserving) keeps any split."""
+        rng = np.random.default_rng(77)
+        x = rng.standard_normal((6, 4, 8)).astype(np.float32)
+        y = rng.standard_normal((6, 4, 8)).astype(np.float32)
+        # cosine over dim=1 with split AFTER the reduced axis -> shifts 2 -> 1
+        got = F.cosine_similarity(ht.array(x, split=2), ht.array(y, split=2), dim=1)
+        assert got.split == 1, got.split
+        _chk(got, tF.cosine_similarity(torch.tensor(x), torch.tensor(y), dim=1),
+             "cos split2")
+        # split BEFORE the reduced axis survives
+        got0 = F.cosine_similarity(ht.array(x, split=0), ht.array(y, split=0), dim=1)
+        assert got0.split == 0
+        # normalize keeps the split (shape-preserving)
+        gn = F.normalize(ht.array(x, split=2), dim=1)
+        assert gn.split == 2
+        _chk(gn, tF.normalize(torch.tensor(x), dim=1), "normalize split2")
+        # pairwise over the last dim: batch split survives
+        gp = F.pairwise_distance(ht.array(x[:, 0], split=0), ht.array(y[:, 0], split=0))
+        assert gp.split == 0
+        _chk(gp, tF.pairwise_distance(torch.tensor(x[:, 0]), torch.tensor(y[:, 0])),
+             "pdist split0")
