@@ -396,21 +396,73 @@ def one_hot(x, num_classes: int):
     return _rewrap(out, proto) if proto is not None else out
 
 
-def nll_loss(log_probs, target, reduction: str = "mean"):
-    """Negative log likelihood over log-probabilities (torch semantics)."""
-    lp, _ = _unwrap(log_probs)
-    t, _ = _unwrap(target)
-    picked = jnp.take_along_axis(lp, t[:, None].astype(jnp.int64), axis=1)[:, 0]
+def _nll_core(lp2, tflat, weight, ignore_index):
+    """Shared masking machinery of nll_loss/cross_entropy on flattened (M, C)
+    log-probs: returns (picked, w, keep) with ignored targets zero-weighted."""
+    keep = tflat != ignore_index
+    safe = jnp.where(keep, tflat, 0)
+    picked = jnp.take_along_axis(lp2, safe[:, None], axis=1)[:, 0]
+    w = weight[safe] if weight is not None else jnp.ones_like(picked)
+    w = jnp.where(keep, w, 0.0)
+    return picked, w, keep
+
+
+def _class_flatten(x, target):
+    """torch loss shapes: input (N, C) or (N, C, d1..dk) with the class dim at
+    axis 1; returns ((M, C) view, flat int targets, target shape)."""
+    t = target.astype(jnp.int32)
+    if x.ndim > 2:
+        c = x.shape[1]
+        x2 = jnp.moveaxis(x, 1, -1).reshape(-1, c)
+    else:
+        x2 = x
+    return x2, t.reshape(-1), t.shape
+
+
+def _loss_reduce(per, w, reduction, out_shape, proto):
     if reduction == "mean":
-        return -jnp.mean(picked)
+        # all-ignored batches divide 0/0 -> NaN, matching torch
+        return jnp.sum(per) / jnp.sum(w)
     if reduction == "sum":
-        return -jnp.sum(picked)
-    return -picked
+        return jnp.sum(per)
+    out = per.reshape(out_shape)
+    return _rewrap(out, proto) if proto is not None else out
 
 
-def cross_entropy(logits, target, reduction: str = "mean"):
-    lg, _ = _unwrap(logits)
-    return nll_loss(jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1), target, reduction)
+def nll_loss(log_probs, target, weight=None, ignore_index: int = -100,
+             reduction: str = "mean"):
+    """Negative log likelihood over log-probabilities (torch semantics incl.
+    per-class ``weight``, ``ignore_index`` and K-dimensional (N, C, d1..dk)
+    inputs; ignored targets contribute 0 and are excluded from the
+    weighted-mean denominator)."""
+    lp, plp = _unwrap(log_probs)
+    t, pt = _unwrap(target)
+    weight = _p(weight)
+    lp2, tflat, tshape = _class_flatten(lp, t)
+    picked, w, keep = _nll_core(lp2, tflat, weight, ignore_index)
+    per = -picked * w
+    proto = plp if plp is not None else pt
+    return _loss_reduce(per, w, reduction, tshape, proto)
+
+
+def cross_entropy(logits, target, weight=None, ignore_index: int = -100,
+                  reduction: str = "mean", label_smoothing: float = 0.0):
+    """Softmax cross-entropy on raw logits (torch semantics incl. ``weight``,
+    ``ignore_index``, ``label_smoothing`` — the target distribution becomes
+    (1-ls)·onehot + ls/C — and K-dimensional (N, C, d1..dk) inputs)."""
+    lg, plg = _unwrap(logits)
+    t, pt = _unwrap(target)
+    weight = _p(weight)
+    lg2, tflat, tshape = _class_flatten(lg.astype(jnp.float32), t)
+    lp2 = jax.nn.log_softmax(lg2, axis=-1)
+    picked, w, keep = _nll_core(lp2, tflat, weight, ignore_index)
+    per = -picked * w
+    if label_smoothing:
+        c = lp2.shape[-1]
+        smooth = jnp.sum(lp2 * (weight if weight is not None else 1.0), axis=-1) / c
+        per = (1.0 - label_smoothing) * per - label_smoothing * jnp.where(keep, smooth, 0.0)
+    proto = plg if plg is not None else pt
+    return _loss_reduce(per, w, reduction, tshape, proto)
 
 
 def mse_loss(pred, target, reduction: str = "mean"):

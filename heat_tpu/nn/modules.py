@@ -652,22 +652,38 @@ class L1Loss:
 
 
 class NLLLoss:
-    """Negative log likelihood over log-probabilities (torch.nn.NLLLoss semantics)."""
+    """Negative log likelihood over log-probabilities (torch.nn.NLLLoss semantics
+    incl. per-class ``weight``, ``ignore_index`` and ``reduction``)."""
+
+    def __init__(self, weight=None, ignore_index: int = -100,
+                 reduction: str = "mean"):
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
 
     def __call__(self, log_probs, target):
-        lp, t = _to_value(log_probs), _to_value(target)
-        picked = jnp.take_along_axis(lp, t[:, None].astype(jnp.int64), axis=1)
-        return -jnp.mean(picked)
+        from . import functional as F
+
+        return F.nll_loss(log_probs, target, self.weight, self.ignore_index,
+                          self.reduction)
 
 
 class CrossEntropyLoss:
-    """Softmax cross-entropy on raw logits (torch.nn.CrossEntropyLoss semantics)."""
+    """Softmax cross-entropy on raw logits (torch.nn.CrossEntropyLoss semantics
+    incl. ``weight``, ``ignore_index``, ``reduction`` and ``label_smoothing``)."""
+
+    def __init__(self, weight=None, ignore_index: int = -100,
+                 reduction: str = "mean", label_smoothing: float = 0.0):
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.label_smoothing = label_smoothing
 
     def __call__(self, logits, target):
-        lg, t = _to_value(logits), _to_value(target)
-        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
-        picked = jnp.take_along_axis(lp, t[:, None].astype(jnp.int64), axis=1)
-        return -jnp.mean(picked)
+        from . import functional as F
+
+        return F.cross_entropy(logits, target, self.weight, self.ignore_index,
+                               self.reduction, self.label_smoothing)
 
 
 class Embedding(Module):

@@ -278,3 +278,65 @@ class TestDistanceFunctionals:
         assert gp.split == 0
         _chk(gp, tF.pairwise_distance(torch.tensor(x[:, 0]), torch.tensor(y[:, 0])),
              "pdist split0")
+
+
+class TestLossOptions:
+    @pytest.mark.parametrize("case", range(N_CASES))
+    def test_cross_entropy_nll_full_options(self, case):
+        """weight / ignore_index / reduction / label_smoothing parity vs torch,
+        through both the functionals and the loss classes."""
+        rng = np.random.default_rng(1500 + case)
+        N, C = int(rng.integers(2, 12)), int(rng.integers(2, 7))
+        lg = rng.standard_normal((N, C)).astype(np.float32)
+        t = rng.integers(0, C, N)
+        if rng.random() < 0.5 and N > 2:
+            t[rng.integers(0, N)] = -100  # ignored target
+        w = (rng.random(C) + 0.5).astype(np.float32) if rng.random() < 0.5 else None
+        red = str(rng.choice(["mean", "sum", "none"]))
+        ls = float(rng.choice([0.0, 0.1, 0.3]))
+        tw = None if w is None else torch.tensor(w)
+        jw = None if w is None else jnp.asarray(w)
+        got = F.cross_entropy(jnp.asarray(lg), jnp.asarray(t), weight=jw,
+                              ignore_index=-100, reduction=red, label_smoothing=ls)
+        want = tF.cross_entropy(torch.tensor(lg), torch.tensor(t), weight=tw,
+                                ignore_index=-100, reduction=red, label_smoothing=ls)
+        np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=3e-5, atol=3e-5)
+        crit = ht.nn.CrossEntropyLoss(weight=jw, ignore_index=-100,
+                                      reduction=red, label_smoothing=ls)
+        np.testing.assert_allclose(
+            np.asarray(crit(jnp.asarray(lg), jnp.asarray(t))), want.numpy(),
+            rtol=3e-5, atol=3e-5)
+        lp = tF.log_softmax(torch.tensor(lg), dim=-1)
+        got_n = ht.nn.NLLLoss(weight=jw, ignore_index=-100, reduction=red)(
+            jnp.asarray(lp.numpy()), jnp.asarray(t))
+        want_n = tF.nll_loss(lp, torch.tensor(t), weight=tw, ignore_index=-100,
+                             reduction=red)
+        np.testing.assert_allclose(np.asarray(got_n), want_n.numpy(),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_loss_kdim_ignored_and_sharded(self):
+        """K-dim (N, C, d1, d2) segmentation shapes, all-ignored NaN semantics,
+        and DNDarray reduction='none' rewrap."""
+        rng = np.random.default_rng(1600)
+        lg = rng.standard_normal((2, 4, 5, 3)).astype(np.float32)
+        t = rng.integers(0, 4, (2, 5, 3))
+        t[0, 1, 1] = -100
+        w = (rng.random(4) + 0.5).astype(np.float32)
+        for red in ("mean", "sum", "none"):
+            for ls in (0.0, 0.2):
+                got = F.cross_entropy(jnp.asarray(lg), jnp.asarray(t),
+                                      weight=jnp.asarray(w), reduction=red,
+                                      label_smoothing=ls)
+                want = tF.cross_entropy(torch.tensor(lg), torch.tensor(t),
+                                        weight=torch.tensor(w), reduction=red,
+                                        label_smoothing=ls)
+                np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                                           rtol=3e-5, atol=3e-5)
+        # all-ignored mean is NaN, matching torch (0/0), not a silent 0
+        allig = F.cross_entropy(jnp.asarray(lg[:, :, 0, 0]), jnp.full(2, -100))
+        assert np.isnan(float(allig))
+        # DNDarray inputs with reduction='none' stay DNDarrays, batch split kept
+        lgd = ht.array(lg[:, :, 0, 0], split=0)
+        td = ht.array(t[:, 0, 0].astype(np.int32), split=0)
+        per = F.cross_entropy(lgd, td, reduction="none")
+        assert isinstance(per, ht.DNDarray) and per.split == 0
