@@ -439,7 +439,7 @@ def nll_loss(log_probs, target, weight=None, ignore_index: int = -100,
     t, pt = _unwrap(target)
     weight = _p(weight)
     lp2, tflat, tshape = _class_flatten(lp, t)
-    picked, w, keep = _nll_core(lp2, tflat, weight, ignore_index)
+    picked, w, _keep = _nll_core(lp2, tflat, weight, ignore_index)
     per = -picked * w
     proto = plp if plp is not None else pt
     return _loss_reduce(per, w, reduction, tshape, proto)
@@ -465,26 +465,30 @@ def cross_entropy(logits, target, weight=None, ignore_index: int = -100,
     return _loss_reduce(per, w, reduction, tshape, proto)
 
 
-def mse_loss(pred, target, reduction: str = "mean"):
-    p, _ = _unwrap(pred)
-    t, _ = _unwrap(target)
-    sq = (p - t) ** 2
+def _ew_loss_reduce(loss, reduction, proto):
+    """Shared reduction tail of the elementwise losses; 'none' re-wraps
+    DNDarray inputs (any split survives — same-shape output)."""
     if reduction == "mean":
-        return jnp.mean(sq)
+        return jnp.mean(loss)
     if reduction == "sum":
-        return jnp.sum(sq)
-    return sq
+        return jnp.sum(loss)
+    if proto is not None:
+        from ..core._operations import wrap_result
+
+        return wrap_result(loss, proto, proto.split)
+    return loss
+
+
+def mse_loss(pred, target, reduction: str = "mean"):
+    p, pp = _unwrap(pred)
+    t, pt = _unwrap(target)
+    return _ew_loss_reduce((p - t) ** 2, reduction, pp if pp is not None else pt)
 
 
 def l1_loss(pred, target, reduction: str = "mean"):
-    p, _ = _unwrap(pred)
-    t, _ = _unwrap(target)
-    d = jnp.abs(p - t)
-    if reduction == "mean":
-        return jnp.mean(d)
-    if reduction == "sum":
-        return jnp.sum(d)
-    return d
+    p, pp = _unwrap(pred)
+    t, pt = _unwrap(target)
+    return _ew_loss_reduce(jnp.abs(p - t), reduction, pp if pp is not None else pt)
 
 
 silu = _elementwise(jax.nn.silu)
@@ -685,64 +689,55 @@ def pad(x, pad_widths, mode: str = "constant", value: float = 0.0):
     return _rewrap(out, proto) if proto is not None else out
 
 
-def binary_cross_entropy(pred, target, reduction: str = "mean"):
-    """torch semantics: inputs are probabilities; log clamped at -100."""
-    p, _ = _unwrap(pred)
-    t, _ = _unwrap(target)
+def binary_cross_entropy(pred, target, weight=None, reduction: str = "mean"):
+    """torch semantics: inputs are probabilities; log clamped at -100;
+    ``weight`` rescales per element (broadcastable)."""
+    p, pp = _unwrap(pred)
+    t, pt = _unwrap(target)
     lo = jnp.maximum(jnp.log(p), -100.0)
     l1 = jnp.maximum(jnp.log1p(-p), -100.0)
     loss = -(t * lo + (1.0 - t) * l1)
-    if reduction == "mean":
-        return jnp.mean(loss)
-    if reduction == "sum":
-        return jnp.sum(loss)
-    return loss
+    if weight is not None:
+        loss = loss * _p(weight)
+    return _ew_loss_reduce(loss, reduction, pp if pp is not None else pt)
 
 
-def binary_cross_entropy_with_logits(pred, target, reduction: str = "mean",
+def binary_cross_entropy_with_logits(pred, target, weight=None,
+                                     reduction: str = "mean",
                                      pos_weight=None):
-    """Numerically-stable sigmoid + BCE (torch semantics)."""
-    z, _ = _unwrap(pred)
-    t, _ = _unwrap(target)
+    """Numerically-stable sigmoid + BCE (torch semantics; ``weight`` rescales
+    per element, ``pos_weight`` rescales the positive class)."""
+    z, pp = _unwrap(pred)
+    t, pt = _unwrap(target)
     # log(1+exp(-|z|)) + max(z,0) - z*t   (with optional positive-class weight)
     log_sig = jax.nn.log_sigmoid(z)
     log_sig_neg = jax.nn.log_sigmoid(-z)
     if pos_weight is not None:
-        loss = -(pos_weight * t * log_sig + (1.0 - t) * log_sig_neg)
+        loss = -(_p(pos_weight) * t * log_sig + (1.0 - t) * log_sig_neg)
     else:
         loss = -(t * log_sig + (1.0 - t) * log_sig_neg)
-    if reduction == "mean":
-        return jnp.mean(loss)
-    if reduction == "sum":
-        return jnp.sum(loss)
-    return loss
+    if weight is not None:
+        loss = loss * _p(weight)
+    return _ew_loss_reduce(loss, reduction, pp if pp is not None else pt)
 
 
 def smooth_l1_loss(pred, target, reduction: str = "mean", beta: float = 1.0):
     """torch semantics: quadratic below ``beta``, linear above; ``beta=0`` is pure
     L1 (guarded separately — a 0/0 in the untaken where-branch would NaN the grad)."""
-    p, _ = _unwrap(pred)
-    t, _ = _unwrap(target)
+    p, pp = _unwrap(pred)
+    t, pt = _unwrap(target)
     d = jnp.abs(p - t)
     if beta == 0.0:
         loss = d
     else:
         loss = jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta)
-    if reduction == "mean":
-        return jnp.mean(loss)
-    if reduction == "sum":
-        return jnp.sum(loss)
-    return loss
+    return _ew_loss_reduce(loss, reduction, pp if pp is not None else pt)
 
 
 def huber_loss(pred, target, reduction: str = "mean", delta: float = 1.0):
     """torch semantics: smooth_l1 scaled by delta (quadratic below ``delta``)."""
-    p, _ = _unwrap(pred)
-    t, _ = _unwrap(target)
+    p, pp = _unwrap(pred)
+    t, pt = _unwrap(target)
     d = jnp.abs(p - t)
     loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
-    if reduction == "mean":
-        return jnp.mean(loss)
-    if reduction == "sum":
-        return jnp.sum(loss)
-    return loss
+    return _ew_loss_reduce(loss, reduction, pp if pp is not None else pt)

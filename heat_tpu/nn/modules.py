@@ -638,17 +638,25 @@ class MSELoss:
     gradient all-reduce implicit — this IS the reference's blocking Allreduce hook
     (``nn/data_parallel.py:220-238``), emitted by XLA instead of written by hand."""
 
+    def __init__(self, reduction: str = "mean"):
+        self.reduction = reduction
+
     def __call__(self, pred, target):
-        p, t = _to_value(pred), _to_value(target)
-        return jnp.mean((p - t) ** 2)
+        from . import functional as F
+
+        return F.mse_loss(pred, target, reduction=self.reduction)
 
 
 class L1Loss:
     """Mean absolute error (torch.nn.L1Loss semantics)."""
 
+    def __init__(self, reduction: str = "mean"):
+        self.reduction = reduction
+
     def __call__(self, pred, target):
-        p, t = _to_value(pred), _to_value(target)
-        return jnp.mean(jnp.abs(p - t))
+        from . import functional as F
+
+        return F.l1_loss(pred, target, reduction=self.reduction)
 
 
 class NLLLoss:
@@ -959,41 +967,57 @@ class ModuleList(Module):
 
 
 class BCELoss:
-    """Binary cross-entropy on probabilities (torch.nn.BCELoss semantics)."""
+    """Binary cross-entropy on probabilities (torch.nn.BCELoss semantics incl.
+    elementwise ``weight`` and ``reduction``)."""
+
+    def __init__(self, weight=None, reduction: str = "mean"):
+        self.weight = weight
+        self.reduction = reduction
 
     def __call__(self, pred, target):
         from . import functional as F
 
-        return F.binary_cross_entropy(pred, target)
+        return F.binary_cross_entropy(pred, target, weight=self.weight,
+                                      reduction=self.reduction)
 
 
 class BCEWithLogitsLoss:
-    """Sigmoid + BCE in one numerically-stable op (torch semantics)."""
+    """Sigmoid + BCE in one numerically-stable op (torch semantics incl.
+    ``weight``, ``reduction`` and ``pos_weight``)."""
 
-    def __init__(self, pos_weight=None):
+    def __init__(self, weight=None, reduction: str = "mean", pos_weight=None):
+        self.weight = weight
+        self.reduction = reduction
         self.pos_weight = pos_weight
 
     def __call__(self, pred, target):
         from . import functional as F
 
-        return F.binary_cross_entropy_with_logits(pred, target, pos_weight=self.pos_weight)
+        return F.binary_cross_entropy_with_logits(
+            pred, target, weight=self.weight, reduction=self.reduction,
+            pos_weight=self.pos_weight,
+        )
 
 
 class SmoothL1Loss:
-    def __init__(self, beta: float = 1.0):
+    def __init__(self, reduction: str = "mean", beta: float = 1.0):
+        self.reduction = reduction
         self.beta = beta
 
     def __call__(self, pred, target):
         from . import functional as F
 
-        return F.smooth_l1_loss(pred, target, beta=self.beta)
+        return F.smooth_l1_loss(pred, target, reduction=self.reduction,
+                                beta=self.beta)
 
 
 class HuberLoss:
-    def __init__(self, delta: float = 1.0):
+    def __init__(self, reduction: str = "mean", delta: float = 1.0):
+        self.reduction = reduction
         self.delta = delta
 
     def __call__(self, pred, target):
         from . import functional as F
 
-        return F.huber_loss(pred, target, delta=self.delta)
+        return F.huber_loss(pred, target, reduction=self.reduction,
+                            delta=self.delta)

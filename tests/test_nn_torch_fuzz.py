@@ -340,3 +340,29 @@ class TestLossOptions:
         td = ht.array(t[:, 0, 0].astype(np.int32), split=0)
         per = F.cross_entropy(lgd, td, reduction="none")
         assert isinstance(per, ht.DNDarray) and per.split == 0
+
+    def test_elementwise_losses_weight_and_rewrap(self):
+        """BCE weight / BCEWithLogits weight+pos_weight parity, and 'none'
+        reduction re-wrapping DNDarray inputs for every elementwise loss."""
+        rng = np.random.default_rng(1700)
+        p = rng.random((8, 3)).astype(np.float32).clip(1e-3, 1 - 1e-3)
+        t = rng.integers(0, 2, (8, 3)).astype(np.float32)
+        w = rng.random((8, 3)).astype(np.float32)
+        z = rng.standard_normal((8, 3)).astype(np.float32)
+        posw = (rng.random(3) + 0.5).astype(np.float32)
+        for red in ("mean", "sum", "none"):
+            _chk(F.binary_cross_entropy(ht.array(p), ht.array(t),
+                                        weight=jnp.asarray(w), reduction=red),
+                 tF.binary_cross_entropy(torch.tensor(p), torch.tensor(t),
+                                         weight=torch.tensor(w), reduction=red),
+                 f"bce {red}")
+            _chk(F.binary_cross_entropy_with_logits(
+                     jnp.asarray(z), jnp.asarray(t), weight=jnp.asarray(w),
+                     reduction=red, pos_weight=jnp.asarray(posw)),
+                 tF.binary_cross_entropy_with_logits(
+                     torch.tensor(z), torch.tensor(t), weight=torch.tensor(w),
+                     reduction=red, pos_weight=torch.tensor(posw)),
+                 f"bcel {red}")
+        for fn in (F.mse_loss, F.l1_loss, F.smooth_l1_loss, F.huber_loss):
+            out = fn(ht.array(z, split=0), ht.array(t, split=0), reduction="none")
+            assert isinstance(out, ht.DNDarray) and out.split == 0, fn.__name__
