@@ -29,26 +29,36 @@ def run_all(filter_substring: Optional[str] = None) -> None:
     TensorBoard/Perfetto)."""
     import contextlib
     import os
+    import sys
 
     import jax
+
+    import traceback
 
     profile_dir = os.environ.get("HEAT_TPU_PROFILE")
     for name, fn in _REGISTRY:
         if filter_substring and filter_substring not in name:
             continue
-        # warmup run compiles; drain it fully so the timed run (and any profiler
-        # trace) measures only steady state, not the queued warmup tail
-        warm = fn()
-        if warm is not None:
-            jax.block_until_ready(warm)
-        ctx = (
-            jax.profiler.trace(os.path.join(profile_dir, name))
-            if profile_dir
-            else contextlib.nullcontext()
-        )
-        with ctx:
-            t0 = time.perf_counter()
-            out = fn()
-            jax.block_until_ready(out) if out is not None else None
-            elapsed = time.perf_counter() - t0
+        try:
+            # warmup run compiles; drain it fully so the timed run (and any
+            # profiler trace) measures only steady state, not the queued tail
+            warm = fn()
+            if warm is not None:
+                jax.block_until_ready(warm)
+            ctx = (
+                jax.profiler.trace(os.path.join(profile_dir, name))
+                if profile_dir
+                else contextlib.nullcontext()
+            )
+            with ctx:
+                t0 = time.perf_counter()
+                out = fn()
+                jax.block_until_ready(out) if out is not None else None
+                elapsed = time.perf_counter() - t0
+        except Exception as e:
+            # one broken/optional-dep benchmark must not truncate the suite
+            traceback.print_exc(file=sys.stderr)
+            print(json.dumps({"benchmark": name, "wall_s": None,
+                              "error": f"{type(e).__name__}: {e}"[:200]}))
+            continue
         print(json.dumps({"benchmark": name, "wall_s": round(elapsed, 4), "backend": jax.default_backend(), "devices": len(jax.devices())}))
