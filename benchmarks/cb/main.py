@@ -23,4 +23,5 @@ import benchmarks.cb.attention  # noqa: F401,E402
 import benchmarks.cb.collectives  # noqa: F401,E402
 
 if __name__ == "__main__":
-    run_all(filter_substring=os.environ.get("HEAT_TPU_BENCH_FILTER"))
+    failed = run_all(filter_substring=os.environ.get("HEAT_TPU_BENCH_FILTER"))
+    sys.exit(1 if failed else 0)

@@ -20,7 +20,7 @@ def monitor(name: Optional[str] = None):
     return decorate
 
 
-def run_all(filter_substring: Optional[str] = None) -> None:
+def run_all(filter_substring: Optional[str] = None) -> int:
     """Run registered benchmarks; one JSON line each.
 
     Set ``HEAT_TPU_PROFILE=<dir>`` to additionally capture a ``jax.profiler`` trace of
@@ -36,6 +36,7 @@ def run_all(filter_substring: Optional[str] = None) -> None:
     import traceback
 
     profile_dir = os.environ.get("HEAT_TPU_PROFILE")
+    failed = 0
     for name, fn in _REGISTRY:
         if filter_substring and filter_substring not in name:
             continue
@@ -56,9 +57,12 @@ def run_all(filter_substring: Optional[str] = None) -> None:
                 jax.block_until_ready(out) if out is not None else None
                 elapsed = time.perf_counter() - t0
         except Exception as e:
-            # one broken/optional-dep benchmark must not truncate the suite
+            # one broken/optional-dep benchmark must not truncate the suite,
+            # but failures still fail the process (CI gates on exit status)
+            failed += 1
             traceback.print_exc(file=sys.stderr)
             print(json.dumps({"benchmark": name, "wall_s": None,
                               "error": f"{type(e).__name__}: {e}"[:200]}))
             continue
         print(json.dumps({"benchmark": name, "wall_s": round(elapsed, 4), "backend": jax.default_backend(), "devices": len(jax.devices())}))
+    return failed

@@ -801,3 +801,34 @@ class TestTransformerDPIntegration:
         acc = float((pred == y).mean())
         assert float(l) < l0 * 0.5
         assert acc > 0.9, acc
+
+
+class TestTransformerFuzz:
+    @pytest.mark.parametrize("case", range(8))
+    def test_encoder_layer_hyperparam_fuzz(self, case):
+        """Random (E, H, FF, norm_first, activation, batch_first) vs torch —
+        including the (T, B, E) batch_first=False layout no other test drives."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(2000 + case)
+        H = int(rng.choice([1, 2, 4]))
+        E = H * int(rng.choice([2, 4, 8]))
+        FF = int(rng.integers(4, 33))
+        B, T = int(rng.integers(1, 4)), int(rng.integers(2, 9))
+        norm_first = bool(rng.integers(0, 2))
+        batch_first = bool(rng.integers(0, 2))
+        activation = str(rng.choice(["relu", "gelu"]))
+        shape = (B, T, E) if batch_first else (T, B, E)
+        x = rng.standard_normal(shape).astype(np.float32)
+        tl = torch.nn.TransformerEncoderLayer(
+            E, H, dim_feedforward=FF, dropout=0.0, activation=activation,
+            batch_first=batch_first, norm_first=norm_first,
+        ).eval()
+        hl = ht.nn.TransformerEncoderLayer(
+            E, H, dim_feedforward=FF, dropout=0.0, activation=activation,
+            batch_first=batch_first, norm_first=norm_first,
+        )
+        params = TestTransformerEncoder._map_params(hl.params, tl)
+        got = np.asarray(hl.apply(params, jnp.asarray(x), is_causal=False))
+        want = tl(torch.tensor(x)).detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5,
+                                   err_msg=f"case {case} bf={batch_first} nf={norm_first}")
