@@ -37,9 +37,11 @@ def run_all(filter_substring: Optional[str] = None) -> int:
 
     profile_dir = os.environ.get("HEAT_TPU_PROFILE")
     failed = 0
+    ran = 0
     for name, fn in _REGISTRY:
         if filter_substring and filter_substring not in name:
             continue
+        ran += 1
         try:
             # warmup run compiles; drain it fully so the timed run (and any
             # profiler trace) measures only steady state, not the queued tail
@@ -65,4 +67,9 @@ def run_all(filter_substring: Optional[str] = None) -> int:
                               "error": f"{type(e).__name__}: {e}"[:200]}))
             continue
         print(json.dumps({"benchmark": name, "wall_s": round(elapsed, 4), "backend": jax.default_backend(), "devices": len(jax.devices())}))
+    if ran == 0:
+        # a typo'd filter must not let CI pass green on an empty run
+        print(json.dumps({"benchmark": None, "wall_s": None,
+                          "error": f"filter {filter_substring!r} matched no benchmarks"}))
+        return 1
     return failed

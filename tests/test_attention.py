@@ -832,3 +832,34 @@ class TestTransformerFuzz:
         want = tl(torch.tensor(x)).detach().numpy()
         np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5,
                                    err_msg=f"case {case} bf={batch_first} nf={norm_first}")
+
+    @pytest.mark.parametrize("case", range(4))
+    def test_decoder_layer_hyperparam_fuzz(self, case):
+        """Decoder twin of the encoder sweep: random hyperparams + both layouts."""
+        torch = pytest.importorskip("torch")
+        rng = np.random.default_rng(2100 + case)
+        H = int(rng.choice([1, 2, 4]))
+        E = H * int(rng.choice([2, 4, 8]))
+        FF = int(rng.integers(4, 25))
+        B, Tt, Tm = int(rng.integers(1, 4)), int(rng.integers(2, 7)), int(rng.integers(2, 9))
+        # stratified so every (norm_first, batch_first) combination is drawn
+        norm_first = bool(case % 2)
+        batch_first = bool((case // 2) % 2)
+        activation = str(rng.choice(["relu", "gelu"]))
+        tshape = (B, Tt, E) if batch_first else (Tt, B, E)
+        mshape = (B, Tm, E) if batch_first else (Tm, B, E)
+        tgt = rng.standard_normal(tshape).astype(np.float32)
+        mem = rng.standard_normal(mshape).astype(np.float32)
+        tl = torch.nn.TransformerDecoderLayer(
+            E, H, dim_feedforward=FF, dropout=0.0, activation=activation,
+            batch_first=batch_first, norm_first=norm_first,
+        ).eval()
+        hl = ht.nn.TransformerDecoderLayer(
+            E, H, dim_feedforward=FF, dropout=0.0, activation=activation,
+            batch_first=batch_first, norm_first=norm_first,
+        )
+        params = TestTransformerDecoder._map_params(hl.params, tl)
+        got = np.asarray(hl.apply(params, jnp.asarray(tgt), jnp.asarray(mem)))
+        want = tl(torch.tensor(tgt), torch.tensor(mem)).detach().numpy()
+        np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5,
+                                   err_msg=f"case {case} bf={batch_first} nf={norm_first}")
