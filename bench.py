@@ -190,6 +190,46 @@ def _backend_reachable(timeout_s: float = 150.0, attempts: int = 3) -> bool:
     return False
 
 
+def _cache_path():
+    import os
+
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_CACHE.json")
+
+
+def _emit_cached_or_null(reason: str, fail_metric: str) -> None:
+    """The relay died: re-emit the last on-chip measurement taken earlier in the
+    round (marked ``cached`` with its timestamp) rather than a null record — round 3
+    shipped zero perf evidence because the relay was down exactly at round end.
+    Entries older than 12 h are not reused: a stale cache must never masquerade as a
+    current measurement."""
+    import calendar
+    import os
+
+    if os.path.exists(_cache_path()):
+        try:
+            with open(_cache_path()) as f:
+                cached = json.load(f)
+            measured_at = cached.get("measured_at", "")
+            age_s = time.time() - calendar.timegm(
+                time.strptime(measured_at, "%Y-%m-%dT%H:%M:%SZ")
+            )
+            if 0 <= age_s < 12 * 3600:
+                cached["cached"] = True
+                cached["error"] = (
+                    f"{reason}; re-emitting the measurement taken "
+                    f"{age_s / 3600:.1f} h ago at {measured_at}"
+                )
+                print(json.dumps(cached))
+                return
+        except Exception:
+            pass
+    print(json.dumps({
+        "metric": fail_metric, "value": None, "unit": "TFLOP/s",
+        "vs_baseline": None,
+        "error": f"{reason}; no fresh cached measurement from earlier in the round",
+    }))
+
+
 def main():
     import sys
     import traceback
@@ -199,12 +239,7 @@ def main():
 
     if not _backend_reachable():
         # Emit a parseable line instead of hanging forever at round end.
-        print(json.dumps({
-            "metric": _FAIL_METRIC, "value": None, "unit": "TFLOP/s",
-            "vs_baseline": None,
-            "error": "accelerator backend unreachable (relay down); see BENCH_r02.json "
-                     "for the last recorded numbers",
-        }))
+        _emit_cached_or_null("accelerator backend unreachable (relay down)", _FAIL_METRIC)
         return
 
     import jax
@@ -227,6 +262,8 @@ def main():
             if attempt < 2:
                 time.sleep(60)
     if tflops is None:
+        # backend reachable but the benchmark itself failed — that could be a real
+        # regression, so report it honestly instead of substituting cached numbers
         print(json.dumps({"metric": _FAIL_METRIC, "value": None,
                           "unit": "TFLOP/s", "vs_baseline": None,
                           "error": "matmul benchmark failed on all 3 attempts "
@@ -259,17 +296,21 @@ def main():
 
     # vs_baseline = fraction of the chip's bf16 matmul peak; CPU: no target
     peak = _peak_tflops(jax) if on_tpu else max(tflops, 1e-9)
-    print(
-        json.dumps(
-            {
-                "metric": f"matmul_{n}x{n}_{dtype_name}_split0x1_tflops_per_chip",
-                "value": round(tflops, 3),
-                "unit": "TFLOP/s",
-                "vs_baseline": round(tflops / peak, 4),
-                "extra_metrics": extras,
-            }
-        )
-    )
+    record = {
+        "metric": f"matmul_{n}x{n}_{dtype_name}_split0x1_tflops_per_chip",
+        "value": round(tflops, 3),
+        "unit": "TFLOP/s",
+        "vs_baseline": round(tflops / peak, 4),
+        "extra_metrics": extras,
+    }
+    if on_tpu:
+        # persist so a later relay outage can still report this round's numbers
+        try:
+            with open(_cache_path(), "w") as f:
+                json.dump({**record, "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}, f)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":
