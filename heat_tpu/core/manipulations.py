@@ -351,12 +351,27 @@ def shape(a: DNDarray) -> Tuple[int, ...]:
 
 
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
-    """Sort along axis; returns ``(values, indices)`` (reference ``manipulations.py:2429``;
-    the distributed sample-sort becomes one jnp.sort whose all-to-all XLA emits)."""
+    """Sort along axis; returns ``(values, indices)`` (reference ``manipulations.py:2429``).
+
+    Along the split axis this runs the distributed merge-split sorting network
+    (:mod:`heat_tpu.core.dist_sort`) — the TPU-native form of the reference's
+    sample-sort, O(n/P) memory per device. Other axes are embarrassingly parallel:
+    one local argsort per shard, no communication."""
+    from . import dist_sort
+
     sanitation.sanitize_in(a)
     axis = sanitize_axis(a.gshape, axis)
-    values = jnp.sort(a.larray, axis=axis, descending=descending)
-    indices = jnp.argsort(a.larray, axis=axis, descending=descending).astype(jnp.int64)
+    comm = a.comm
+    if dist_sort.can_distribute_sort(comm, a.gshape, a.split, axis, a.larray.dtype):
+        values, indices = dist_sort.distributed_sort(
+            comm, comm.shard(a.larray, a.split), axis, descending
+        )
+        indices = indices.astype(jnp.int64)
+    else:
+        indices = jnp.argsort(
+            a.larray, axis=axis, descending=descending, stable=True
+        ).astype(jnp.int64)
+        values = jnp.take_along_axis(a.larray, indices, axis=axis)
     v = _wrap(values, a, a.split)
     i = _wrap(indices, a, a.split)
     return _handle_out(v, out, a), i
@@ -465,12 +480,63 @@ def topk(
     return v, i
 
 
+def _partial_unique_values(a: DNDarray) -> np.ndarray:
+    """Merge of per-shard partial uniques (reference ``manipulations.py:3203``).
+
+    Each device computes the unique set of its own shard (O(n/P) device memory); only
+    those partials — at most the shard size, typically far smaller — leave the device
+    and are merged on host. The full data is never gathered, matching the reference's
+    per-rank-partials-then-merge scheme rather than its worst case."""
+    import jax as _jax
+
+    comm = a.comm
+    v = comm.shard(a.larray, a.split)
+    parts = [np.asarray(jnp.unique(s.data)) for s in v.addressable_shards]
+    np_dtype = np.dtype(a.dtype.jax_type())
+    local = (
+        np.unique(np.concatenate(parts)) if parts else np.empty(0, np_dtype)
+    )
+    if _jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(
+            multihost_utils.process_allgather(np.array([local.size], np.int64))
+        ).reshape(-1)
+        mx = int(counts.max()) if counts.size else 0
+        padded = np.zeros(mx, np_dtype)
+        padded[: local.size] = local
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        local = np.unique(
+            np.concatenate([gathered[p, : int(counts[p])] for p in range(len(counts))])
+        ) if mx else local
+    return local
+
+
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
-    """Unique elements (reference ``manipulations.py:3203``; per-rank partial merge is a
-    single global jnp.unique — results are replicated, matching the reference's gather)."""
+    """Unique elements (reference ``manipulations.py:3203``).
+
+    A flat unique over a split array runs as per-shard partial uniques merged across
+    shards — O(n/P) device memory; the result is replicated like the reference's
+    final gather. The ``axis`` form and unsplit arrays are one global jnp.unique."""
     sanitation.sanitize_in(a)
     if axis is not None:
         axis = sanitize_axis(a.gshape, axis)
+    use_partials = (
+        axis is None
+        and a.split is not None
+        and a.comm.is_distributed()
+        and a.larray.size >= a.comm.size
+    )
+    if use_partials and jnp.issubdtype(a.larray.dtype, jnp.floating):
+        # NaN != NaN breaks the searchsorted inverse and partial-merge dedup; route
+        # arrays containing NaNs through the global path
+        use_partials = not bool(jnp.isnan(a.larray).any())
+    if use_partials:
+        result = jnp.asarray(_partial_unique_values(a))
+        if return_inverse:
+            inverse = jnp.searchsorted(result, a.larray).astype(jnp.int64)
+            return _wrap(result, a, None), _wrap(inverse, a, None)
+        return _wrap(result, a, None)
     if return_inverse:
         result, inverse = jnp.unique(a.larray, return_inverse=True, axis=axis)
         return _wrap(result, a, None), _wrap(inverse.astype(jnp.int64), a, None)

@@ -274,6 +274,36 @@ def minimum(x1, x2, out=None) -> DNDarray:
     return _operations.binary_op(jnp.minimum, x1, x2, out)
 
 
+def _percentile_from_sorted(sv, q_arr, axis, method, keepdims):
+    """Percentiles from already-sorted values: gather the bracketing index planes and
+    interpolate — O(q) gathered planes instead of materialising the sorted global."""
+    n = sv.shape[axis]
+    qshape = q_arr.shape
+    pos = q_arr.reshape(-1) / 100.0 * (n - 1)
+    lo = jnp.clip(jnp.floor(pos), 0, n - 1).astype(jnp.int32)
+    hi = jnp.clip(jnp.ceil(pos), 0, n - 1).astype(jnp.int32)
+    if method == "lower":
+        r = jnp.take(sv, lo, axis=axis)
+    elif method == "higher":
+        r = jnp.take(sv, hi, axis=axis)
+    elif method == "nearest":
+        r = jnp.take(sv, jnp.clip(jnp.rint(pos), 0, n - 1).astype(jnp.int32), axis=axis)
+    elif method == "midpoint":
+        r = (jnp.take(sv, lo, axis=axis) + jnp.take(sv, hi, axis=axis)) / 2
+    else:  # linear
+        a = jnp.take(sv, lo, axis=axis)
+        b = jnp.take(sv, hi, axis=axis)
+        shape = [1] * a.ndim
+        shape[axis] = pos.shape[0]
+        frac = (pos - lo).astype(sv.dtype).reshape(shape)
+        r = a + (b - a) * frac
+    r = jnp.moveaxis(r, axis, 0)  # q dim to front, matching jnp.percentile layout
+    rest = r.shape[1:]
+    if keepdims:
+        rest = rest[:axis] + (1,) + rest[axis:]
+    return r.reshape(qshape + rest)
+
+
 def percentile(
     x: DNDarray,
     q,
@@ -282,18 +312,45 @@ def percentile(
     interpolation: str = "linear",
     keepdims: bool = False,
 ) -> DNDarray:
-    """q-th percentile (reference ``statistics.py:1408``; the reference resplits and
-    gathers along the reduction axis — here one global jnp.percentile does it)."""
+    """q-th percentile (reference ``statistics.py:1408``).
+
+    Along a split reduction axis the order statistics come from the distributed
+    merge-split sort (:mod:`heat_tpu.core.dist_sort`) followed by a gather of just the
+    two bracketing index planes — O(n/P) memory per device, the property the
+    reference's resplit+local-sort scheme provides. Other configurations are one
+    global ``jnp.percentile``."""
+    from . import dist_sort
+
     sanitation.sanitize_in(x)
     axis_s = sanitize_axis(x.gshape, axis) if axis is not None else None
     q_arr = jnp.asarray(q, dtype=jnp.float64)
-    result = jnp.percentile(
-        x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32)),
-        q_arr,
-        axis=axis_s,
-        method=interpolation,
-        keepdims=keepdims,
+    work = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+    # axis=None over a 1-D split array is the same reduction with axis=0
+    eff_axis = 0 if (axis_s is None and x.ndim == 1) else axis_s
+    use_dist = (
+        eff_axis is not None
+        and interpolation in ("linear", "lower", "higher", "nearest", "midpoint")
+        and dist_sort.can_distribute_sort(x.comm, x.gshape, x.split, eff_axis, work.dtype)
     )
+    if use_dist:
+        # NaN inputs must yield NaN like jnp.percentile; the sorted-order-statistics
+        # path would interpolate finite planes instead, so route those globally
+        use_dist = not bool(jnp.isnan(work).any())
+    if use_dist:
+        sv, _ = dist_sort.distributed_sort(x.comm, x.comm.shard(work, x.split), eff_axis)
+        result = _percentile_from_sorted(
+            sv, q_arr, eff_axis, interpolation, keepdims
+        )
+        if axis_s is None:  # scalar-q + axis=None conventions already match (ndim-1 case)
+            axis_s = eff_axis
+    else:
+        result = jnp.percentile(
+            work,
+            q_arr,
+            axis=axis_s,
+            method=interpolation,
+            keepdims=keepdims,
+        )
     out_split = _operations._out_split_reduce(x, axis_s, keepdims) if axis_s is not None else None
     if out_split is not None and np.ndim(q):  # leading q dim shifts the split
         out_split += np.ndim(q)
