@@ -46,13 +46,15 @@ def _ensure_dndarray(x, device=None, comm=None) -> DNDarray:
 
 def wrap_result(value, proto: DNDarray, split: Optional[int]) -> DNDarray:
     """Wrap a raw jax value in a DNDarray with ``proto``'s device/comm, normalising an
-    out-of-range split to None and laying the value out accordingly."""
+    out-of-range split to None and laying the value out accordingly (ragged split
+    extents store physically padded — comm.shard)."""
     if split is not None and (value.ndim == 0 or split >= value.ndim or split < 0):
         split = None
+    gshape = tuple(value.shape)
     value = proto.comm.shard(value, split)
     return DNDarray(
         value,
-        tuple(value.shape),
+        gshape,
         types.canonical_heat_type(value.dtype),
         split,
         proto.device,
@@ -205,9 +207,10 @@ def local_op(
         sanitation.sanitize_out(out, x.gshape, x.split, x.device)
         out.larray = x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)
         return out
+    gshape = tuple(result.shape)
     result = x.comm.shard(result, x.split)
     return DNDarray(
-        result, tuple(result.shape), types.canonical_heat_type(result.dtype), x.split, x.device, x.comm, x.balanced
+        result, gshape, types.canonical_heat_type(result.dtype), x.split, x.device, x.comm, x.balanced
     )
 
 

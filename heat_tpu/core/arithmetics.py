@@ -136,8 +136,9 @@ def diff(a: DNDarray, n: int = 1, axis: int = -1, prepend=None, append=None) -> 
     split = a.split
     if split is not None and result.shape[split] == 0:
         split = None
+    gshape = tuple(result.shape)
     result = a.comm.shard(result, split)
-    return DNDarray(result, tuple(result.shape), types.canonical_heat_type(result.dtype), split, a.device, a.comm, True)
+    return DNDarray(result, gshape, types.canonical_heat_type(result.dtype), split, a.device, a.comm, True)
 
 
 def div(t1, t2, out=None, where=None) -> DNDarray:

@@ -67,11 +67,12 @@ def _rebuild_tree(tree: Any, restored: dict, comm, device) -> Any:
             split_ax = leaf.split
             leaf_comm = comm if comm is not None else leaf.comm
             leaf_device = device if device is not None else leaf.device
+            gshape = tuple(jax.numpy.asarray(value).shape)
             arr = leaf_comm.shard(jax.numpy.asarray(value), split_ax)
             out_leaves.append(
                 DNDarray(
                     arr,
-                    tuple(arr.shape),
+                    gshape,
                     _types.canonical_heat_type(arr.dtype),
                     split_ax,
                     leaf_device,

@@ -259,13 +259,28 @@ class MeshCommunication(Communication):
         """The ``NamedSharding`` encoding Heat's ``split`` for an ``ndim``-d array."""
         return NamedSharding(self.mesh, self.spec(ndim, split))
 
+    def padded_dim(self, n: int) -> int:
+        """The physical extent of a split dimension: ``n`` rounded up to a multiple of
+        the mesh size, so every shard holds exactly ``ceil(n/P)`` elements."""
+        n = int(n)
+        c = -(-n // self.size) if n else 0
+        return c * self.size
+
     def shard(self, array: jax.Array, split: Optional[int]) -> jax.Array:
         """Lay ``array`` out with dimension ``split`` sharded over the mesh.
 
         This is the physical half of ``resplit_`` (reference ``dndarray.py:1407``): XLA
         emits the all-gather / all-to-all / slice that the reference hand-writes.
-        Divisible dims go through ``device_put`` (no compilation); ragged dims go through
-        a jitted ``with_sharding_constraint``, which GSPMD supports via internal padding.
+
+        ``array`` is a *logical* value. Ragged extents (``n % P != 0``) return a
+        **padded physical** value — the split dimension zero-padded to
+        :meth:`padded_dim` so a true 1/P ``NamedSharding`` applies (jax.Array cannot
+        represent uneven shards, and GSPMD resolves a forced ragged constraint to
+        replication) — the padded-chunks representation SURVEY §7 prescribes. Callers
+        wrap the result together with the logical gshape (``DNDarray`` keeps the
+        logical/physical distinction); a padded input (whose extent is already a
+        multiple of P) passes through the divisible path unchanged, so the operation
+        is idempotent on physical values.
         """
         if jnp.issubdtype(getattr(array, "dtype", None), jnp.complexfloating):
             from .devices import complex_needs_host, cpu_fallback_device
@@ -283,19 +298,29 @@ class MeshCommunication(Communication):
         target = self.sharding(array.ndim, split)
         if isinstance(array, jax.Array) and array.sharding == target:
             return array
+        ragged = split is not None and array.shape[split] % self.size != 0
         if jax.process_count() > 1:
             # multi-controller: a host value can only populate addressable shards —
             # build per-shard via callback (each process fills only its own devices);
             # an existing global array reshard compiles to the XLA collective.
             if isinstance(array, jax.Array) and not array.is_fully_addressable:
-                return _ragged_reshard(array, target)
+                return _pad_reshard(array, target, split, self.padded_dim(array.shape[split]) if ragged else None)
             np_value = np.asarray(array)
+            if ragged:
+                widths = [(0, 0)] * np_value.ndim
+                widths[split] = (0, self.padded_dim(np_value.shape[split]) - np_value.shape[split])
+                np_value = np.pad(np_value, widths)
             return jax.make_array_from_callback(
                 np_value.shape, target, lambda idx: np_value[idx]
             )
-        if split is None or array.shape[split] % self.size == 0:
+        if not ragged:
             return jax.device_put(array, target)
-        return _ragged_reshard(array, target)
+        m = self.padded_dim(array.shape[split])
+        pad_shape = array.shape[:split] + (m - array.shape[split],) + array.shape[split + 1 :]
+        padded = jnp.concatenate(
+            [jnp.asarray(array), jnp.zeros(pad_shape, jnp.asarray(array).dtype)], axis=split
+        )
+        return jax.device_put(padded, target)
 
     # ------------------------------------------------------------------ collectives
     # Functional collectives usable inside shard_map blocks. Names kept close to the
@@ -425,16 +450,30 @@ class MeshCommunication(Communication):
 
 
 # A jitted, cached reshard for ragged (non-divisible) dims: GSPMD pads internally.
-_ragged_cache: dict = {}
+_pad_cache: dict = {}
 
 
-def _ragged_reshard(array: jax.Array, target: NamedSharding) -> jax.Array:
-    key = (target, array.ndim)  # NamedSharding hashes mesh + devices, so two
-    # same-shape meshes over different device sets cannot collide
-    fn = _ragged_cache.get(key)
+def _pad_reshard(
+    array: jax.Array, target: NamedSharding, split: Optional[int], padded: Optional[int]
+) -> jax.Array:
+    """Reshard a (possibly non-addressable) global array, zero-padding a ragged split
+    dimension to ``padded`` inside the jitted program so the output satisfies a true
+    1/P NamedSharding."""
+    key = (target, array.ndim, split, padded)  # NamedSharding hashes mesh + devices,
+    # so two same-shape meshes over different device sets cannot collide
+    fn = _pad_cache.get(key)
     if fn is None:
-        fn = jax.jit(lambda x: jax.lax.with_sharding_constraint(x, target))
-        _ragged_cache[key] = fn
+        if padded is None:
+            fn = jax.jit(lambda x: x, out_shardings=target)
+        else:
+
+            def _pad(x):
+                widths = [(0, 0)] * x.ndim
+                widths[split] = (0, padded - x.shape[split])
+                return jnp.pad(x, widths)
+
+            fn = jax.jit(_pad, out_shardings=target)
+        _pad_cache[key] = fn
     return fn(array)
 
 

@@ -103,40 +103,54 @@ _SORTER_CACHE: dict = {}
 
 
 def distributed_sort(
-    comm, value: jax.Array, axis: int, descending: bool = False
+    comm, value: jax.Array, axis: int, descending: bool = False, logical_n: int = None
 ) -> Tuple[jax.Array, jax.Array]:
     """Sort a globally-sharded array along its sharded ``axis``.
 
-    Returns ``(values, indices)``, both sharded along ``axis`` like the input; indices
-    are int64 positions into the original global axis with ``jnp.argsort(stable=True)``
-    tie order in both directions.
+    ``value`` may be the logical array or the padded physical layout of a
+    ``logical_n``-extent array (``comm.shard``'s zero-padding is overwritten with the
+    proper sort sentinel in place, shard-locally). Returns ``(values, indices)`` in
+    **padded physical form** — the logical result occupies ``[0:logical_n)`` along
+    ``axis``; pad slots hold sentinels past it. Indices are positions into the
+    original global axis with ``jnp.argsort(stable=True)`` tie order in both
+    directions. End-to-end the computation touches O(n/P) per device.
     """
-    key = (comm.mesh, comm.axis_name, axis, bool(descending))
+    n = int(logical_n) if logical_n is not None else value.shape[axis]
+    key = (comm.mesh, comm.axis_name, axis, bool(descending), n, value.shape)
     fn = _SORTER_CACHE.get(key)
     if fn is None:
-        if len(_SORTER_CACHE) >= 64:
+        if len(_SORTER_CACHE) >= 256:
             _SORTER_CACHE.clear()
         mesh, axis_name, nproc = comm.mesh, comm.axis_name, comm.size
         fn = jax.jit(
-            lambda v: _sort_impl(mesh, axis_name, nproc, v, axis, descending)
+            lambda v: _sort_impl(mesh, axis_name, nproc, v, axis, descending, n)
         )
         _SORTER_CACHE[key] = fn
     return fn(value)
 
 
 def _sort_impl(
-    mesh, axis_name: str, nproc: int, value: jax.Array, axis: int, descending: bool
+    mesh, axis_name: str, nproc: int, value: jax.Array, axis: int, descending: bool,
+    n: int,
 ) -> Tuple[jax.Array, jax.Array]:
-    n = value.shape[axis]
-    pad = (-n) % nproc
-    if pad:
-        pad_shape = value.shape[:axis] + (pad,) + value.shape[axis + 1 :]
+    c = -(-n // nproc) if n else 0
+    m = c * nproc
+    sentinel = _pad_sentinel(value.dtype, descending)
+    if value.shape[axis] == n and m > n:
+        # logical input: append the pad region
+        pad_shape = value.shape[:axis] + (m - n,) + value.shape[axis + 1 :]
         value = jnp.concatenate(
-            [value, jnp.full(pad_shape, _pad_sentinel(value.dtype, descending), value.dtype)],
-            axis=axis,
+            [value, jnp.full(pad_shape, sentinel, value.dtype)], axis=axis
         )
-    m = n + pad
+    elif value.shape[axis] != m:
+        raise ValueError(
+            f"extent {value.shape[axis]} along axis {axis} is neither the logical {n} "
+            f"nor the padded {m}"
+        )
     iota = jax.lax.broadcasted_iota(jnp.int64, value.shape, axis)
+    if m > n:
+        # overwrite comm.shard's zero padding with the sort sentinel, shard-locally
+        value = jnp.where(iota >= n, sentinel, value)
     if descending:
         operands = (value, (m - 1) - iota, iota)
     else:
@@ -145,7 +159,6 @@ def _sort_impl(
     rounds = _network_rounds(nproc)
     partner_tab = np.array([r[0] for r in rounds], dtype=np.int32)
     keep_lower_tab = np.array([r[1] for r in rounds], dtype=bool)
-    c = m // nproc
 
     def network(*ops):
         i = jax.lax.axis_index(axis_name)
@@ -183,10 +196,9 @@ def _sort_impl(
     )(*operands)
     values, indices = out[0], out[-1]
 
-    if pad:
-        start = pad if descending else 0
-        values = jax.lax.slice_in_dim(values, start, start + n, axis=axis)
-        indices = jax.lax.slice_in_dim(indices, start, start + n, axis=axis)
+    # descending: ascending network with min-sentinels leaves pads at the head; the
+    # axis flip yields descending values with ties in original order AND moves the
+    # pads to the tail — the padded-physical convention, with no slicing (shard-local)
     if descending:
         values = jnp.flip(values, axis=axis)
         indices = jnp.flip(indices, axis=axis)

@@ -92,35 +92,104 @@ class DNDarray:
         self.__halo_prev: Optional[jax.Array] = None
 
     # ------------------------------------------------------------------ properties
+    def _is_padded(self) -> bool:
+        """Whether the physical value carries SURVEY §7's padded-chunk layout: a ragged
+        split extent stored rounded up to P-divisibility so shards are a true 1/P."""
+        return (
+            self.__split is not None
+            and self.__array.ndim > self.__split
+            and self.__array.shape[self.__split] != self.__gshape[self.__split]
+        )
+
+    def _padded_gshape(self, gshape=None) -> Tuple[int, ...]:
+        gshape = self.__gshape if gshape is None else gshape
+        if self.__split is None or self.__split >= len(gshape):
+            return tuple(gshape)
+        m = self.__comm.padded_dim(gshape[self.__split])
+        return gshape[: self.__split] + (m,) + gshape[self.__split + 1 :]
+
+    def _logical(self) -> jax.Array:
+        """The logical global value: the physical array with any layout padding sliced
+        off. For divisible extents this IS the stored array (no copy); for ragged ones
+        the eager slice materialises a replicated temporary — callers that care about
+        per-device memory should consume :attr:`parray` / :meth:`iter_shards`."""
+        if not self._is_padded():
+            return self.__array
+        sl = tuple(slice(0, s) for s in self.__gshape)
+        return self.__array[sl]
+
     @property
     def larray(self) -> jax.Array:
-        """The underlying ``jax.Array``.
+        """The underlying global ``jax.Array`` (logical shape).
 
         In the reference this is the process-local torch tensor (``dndarray.py:131``); in
         single-controller JAX the addressable value *is* the global array (per-shard views
         are exposed via :attr:`lshards`). Multi-controller processes see their
-        addressable shards through the same object.
+        addressable shards through the same object. Ragged split extents are stored
+        physically padded (:attr:`parray`); this accessor always returns the logical
+        extent.
         """
-        return self.__array
+        return self._logical()
 
     @larray.setter
     def larray(self, array: jax.Array) -> None:
-        """Rebind the payload (reference setter ``dndarray.py:146-168``)."""
+        """Rebind the payload (reference setter ``dndarray.py:146-168``).
+
+        Accepts either a logical-shape value or the padded physical form of the
+        *current* gshape (as produced by ``comm.shard``); any other shape rebinds the
+        logical gshape to the value's shape."""
         if not isinstance(array, jax.Array):
             raise TypeError(f"larray must be a jax.Array, got {type(array)}")
         self.__array = array
-        self.__gshape = tuple(array.shape)
+        if tuple(array.shape) != self._padded_gshape():
+            self.__gshape = tuple(array.shape)
         self.__dtype = types.canonical_heat_type(array.dtype)
+
+    @property
+    def parray(self) -> jax.Array:
+        """The physical ``jax.Array`` as laid out in device memory — equal to
+        :attr:`larray` except for ragged split extents, where the split dimension is
+        zero-padded to ``ceil(n/P)*P`` so shards are an exact 1/P."""
+        return self.__array
 
     @property
     def garray(self) -> jax.Array:
         """Alias emphasising the global nature of the payload."""
-        return self.__array
+        return self._logical()
 
     @property
     def lshards(self) -> List[jax.Array]:
-        """Per-device local shard values addressable from this process."""
-        return [s.data for s in self.__array.addressable_shards]
+        """Per-device local shard values addressable from this process, trimmed to the
+        logical extents (layout padding never escapes)."""
+        return [data for _, data in self.iter_shards()]
+
+    def iter_shards(self):
+        """Yield ``(global_index, shard_value)`` per addressable shard of the physical
+        array, with indices and values trimmed to the logical gshape. Pure-padding
+        shards are skipped. The backbone for per-shard I/O and per-shard algorithms
+        (reference: rank-local hyperslabs, ``io.py:211-238``)."""
+        for shard in self.__array.addressable_shards:
+            if shard.index is None:
+                continue
+            trimmed = []
+            local = []
+            skip = False
+            for d in range(len(self.__gshape)):
+                sl = shard.index[d] if d < len(shard.index) else slice(None)
+                start = sl.start or 0
+                stop = sl.stop if sl.stop is not None else self.__array.shape[d]
+                stop = min(stop, self.__gshape[d])
+                if stop <= start:
+                    skip = True
+                    break
+                trimmed.append(slice(start, stop))
+                local.append(slice(0, stop - start))
+            if skip:
+                continue
+            data = shard.data
+            if self._is_padded():
+                data = data[tuple(local)]
+            yield tuple(trimmed), data
 
     @property
     def balanced(self) -> Optional[bool]:
@@ -298,15 +367,16 @@ class DNDarray:
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
-        self.__array = self.__comm.shard(self.__array, axis)
+        new = self.__comm.shard(self._logical(), axis)
         self.__split = axis
+        self.__array = new
         self.__balanced = True
         return self
 
     def resplit(self, axis: Optional[int] = None) -> "DNDarray":
         """Out-of-place resplit (reference ``manipulations.py:3480``)."""
         axis = sanitize_axis(self.__gshape, axis)
-        new = self.__comm.shard(self.__array, axis)
+        new = self.__comm.shard(self._logical(), axis)
         return DNDarray(new, self.__gshape, self.__dtype, axis, self.__device, self.__comm, True)
 
     def collect_(self, target_rank: int = 0) -> "DNDarray":
@@ -382,7 +452,7 @@ class DNDarray:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
         if not self.__array.is_fully_addressable:
             return self.numpy().reshape(()).item()
-        return self.__array.reshape(()).item()
+        return self._logical().reshape(()).item()
 
     def numpy(self) -> np.ndarray:
         """Gather into a numpy array (reference ``dndarray.py:1169``).
@@ -392,10 +462,13 @@ class DNDarray:
         ``process_allgather`` so every controller returns the same global array —
         the TPU form of the reference's rank-0 gather + Bcast."""
         if self.__array.is_fully_addressable:
-            return np.asarray(self.__array)
+            return np.asarray(self._logical())
         from jax.experimental import multihost_utils
 
-        return np.asarray(multihost_utils.process_allgather(self.__array, tiled=True))
+        full = np.asarray(multihost_utils.process_allgather(self.__array, tiled=True))
+        if full.shape != self.__gshape:  # strip layout padding gathered from shards
+            full = full[tuple(slice(0, s) for s in self.__gshape)]
+        return full
 
     def tolist(self, keepsplit: bool = False) -> list:
         """Nested Python lists (reference ``dndarray.py:1861``)."""
@@ -409,7 +482,7 @@ class DNDarray:
         """Move to host (reference ``dndarray.py:300``)."""
         from . import devices, factories
 
-        arr = np.asarray(self.__array)
+        arr = np.asarray(self._logical())
         return factories.array(arr, dtype=self.__dtype, split=self.__split, device=devices.cpu, comm=self.__comm)
 
     def create_partition_interface(self, no_data: bool = False) -> dict:
@@ -503,14 +576,15 @@ class DNDarray:
 
         new_split = self._index_split(key)
         jkey = _jaxify_key(key)
-        result = self.__array[jkey]
+        result = self._logical()[jkey]
         if result.ndim == 0:
             return factories.array(result, dtype=self.__dtype, device=self.__device, comm=self.__comm)
         if new_split is not None and new_split >= result.ndim:
             new_split = None
+        gshape = tuple(result.shape)
         result = self.__comm.shard(result, new_split)
         return DNDarray(
-            result, tuple(result.shape), self.__dtype, new_split, self.__device, self.__comm, True
+            result, gshape, self.__dtype, new_split, self.__device, self.__comm, True
         )
 
     def __setitem__(self, key, value) -> None:
@@ -519,7 +593,7 @@ class DNDarray:
         if isinstance(value, DNDarray):
             value = value.larray
         value = jnp.asarray(value, dtype=self.__array.dtype)
-        new = self.__array.at[jkey].set(value)
+        new = self._logical().at[jkey].set(value)
         self.__array = self.__comm.shard(new, self.__split)
 
     def __iter__(self):

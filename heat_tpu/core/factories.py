@@ -76,8 +76,9 @@ def _wrap(
     else:
         dtype = types.canonical_heat_type(value.dtype)
     split = sanitize_axis(value.shape, split)
+    gshape = tuple(value.shape)
     value = comm.shard(value, split)
-    return DNDarray(value, tuple(value.shape), dtype, split, device, comm, balanced)
+    return DNDarray(value, gshape, dtype, split, device, comm, balanced)
 
 
 def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:

@@ -196,9 +196,8 @@ if _HAS_ZARR:
             multihost_utils.sync_global_devices(f"heat_tpu.save_zarr:{path}")
             store = _ts.open(_zarr_spec(path)).result()
         futures = [
-            store[shard.index].write(np.asarray(shard.data))
-            for shard in data.larray.addressable_shards
-            if shard.index is not None
+            store[index].write(np.asarray(value))
+            for index, value in data.iter_shards()
         ]
         for f in futures:
             f.result()
@@ -285,9 +284,8 @@ if _HAS_HDF5:
             if data.split is None:
                 dset[...] = np.asarray(data.larray)
             else:
-                for shard in data.larray.addressable_shards:
-                    if shard.index is not None:
-                        dset[shard.index] = np.asarray(shard.data)
+                for index, value in data.iter_shards():
+                    dset[index] = np.asarray(value)
 
 
 if _HAS_NETCDF:

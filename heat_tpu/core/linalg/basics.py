@@ -71,9 +71,10 @@ def _contraction_precision(precision, *operands) -> Optional[jax.lax.Precision]:
 def _wrap_like(value: jax.Array, proto: DNDarray, split: Optional[int]) -> DNDarray:
     if split is not None and (split >= value.ndim or split < 0):
         split = None
+    gshape = tuple(value.shape)
     value = proto.comm.shard(value, split)
     return DNDarray(
-        value, tuple(value.shape), types.canonical_heat_type(value.dtype), split, proto.device, proto.comm, True
+        value, gshape, types.canonical_heat_type(value.dtype), split, proto.device, proto.comm, True
     )
 
 

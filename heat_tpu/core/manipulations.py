@@ -362,11 +362,18 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     sanitation.sanitize_in(a)
     axis = sanitize_axis(a.gshape, axis)
     comm = a.comm
-    if dist_sort.can_distribute_sort(comm, a.gshape, a.split, axis, a.larray.dtype):
+    if dist_sort.can_distribute_sort(comm, a.gshape, a.split, axis, a.parray.dtype):
+        # padded-physical in, padded-physical out: O(n/P) per device end to end
         values, indices = dist_sort.distributed_sort(
-            comm, comm.shard(a.larray, a.split), axis, descending
+            comm, comm.shard(a.parray, a.split), axis, descending,
+            logical_n=a.gshape[axis],
         )
         indices = indices.astype(jnp.int64)
+        v = DNDarray(values, a.gshape, types.canonical_heat_type(values.dtype),
+                     a.split, a.device, a.comm, True)
+        i = DNDarray(indices, a.gshape, types.canonical_heat_type(indices.dtype),
+                     a.split, a.device, a.comm, True)
+        return _handle_out(v, out, a), i
     else:
         indices = jnp.argsort(
             a.larray, axis=axis, descending=descending, stable=True
@@ -489,9 +496,8 @@ def _partial_unique_values(a: DNDarray) -> np.ndarray:
     per-rank-partials-then-merge scheme rather than its worst case."""
     import jax as _jax
 
-    comm = a.comm
-    v = comm.shard(a.larray, a.split)
-    parts = [np.asarray(jnp.unique(s.data)) for s in v.addressable_shards]
+    # iter_shards trims layout padding and yields device-local shard values
+    parts = [np.asarray(jnp.unique(data)) for _, data in a.iter_shards()]
     np_dtype = np.dtype(a.dtype.jax_type())
     local = (
         np.unique(np.concatenate(parts)) if parts else np.empty(0, np_dtype)

@@ -69,8 +69,9 @@ def _next_key(nelem: int) -> jax.Array:
 def _wrap(value: jax.Array, dtype, split, device, comm) -> DNDarray:
     comm = sanitize_comm(comm)
     device = sanitize_device(device)
+    gshape = tuple(value.shape)
     value = comm.shard(value, split)
-    return DNDarray(value, tuple(value.shape), dtype, split, device, comm, True)
+    return DNDarray(value, gshape, dtype, split, device, comm, True)
 
 
 def get_state() -> Tuple[str, int, int, int, float]:

@@ -274,10 +274,12 @@ def minimum(x1, x2, out=None) -> DNDarray:
     return _operations.binary_op(jnp.minimum, x1, x2, out)
 
 
-def _percentile_from_sorted(sv, q_arr, axis, method, keepdims):
+def _percentile_from_sorted(sv, q_arr, axis, method, keepdims, n=None):
     """Percentiles from already-sorted values: gather the bracketing index planes and
-    interpolate — O(q) gathered planes instead of materialising the sorted global."""
-    n = sv.shape[axis]
+    interpolate — O(q) gathered planes instead of materialising the sorted global.
+    ``sv`` may be the padded physical form; ``n`` is the logical extent (pad slots sit
+    past it and are never gathered)."""
+    n = sv.shape[axis] if n is None else n
     qshape = q_arr.shape
     pos = q_arr.reshape(-1) / 100.0 * (n - 1)
     lo = jnp.clip(jnp.floor(pos), 0, n - 1).astype(jnp.int32)
@@ -324,28 +326,33 @@ def percentile(
     sanitation.sanitize_in(x)
     axis_s = sanitize_axis(x.gshape, axis) if axis is not None else None
     q_arr = jnp.asarray(q, dtype=jnp.float64)
-    work = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+    promoted = jnp.promote_types(x.parray.dtype, jnp.float32)
     # axis=None over a 1-D split array is the same reduction with axis=0
     eff_axis = 0 if (axis_s is None and x.ndim == 1) else axis_s
     use_dist = (
         eff_axis is not None
         and interpolation in ("linear", "lower", "higher", "nearest", "midpoint")
-        and dist_sort.can_distribute_sort(x.comm, x.gshape, x.split, eff_axis, work.dtype)
+        and dist_sort.can_distribute_sort(x.comm, x.gshape, x.split, eff_axis, promoted)
     )
     if use_dist:
         # NaN inputs must yield NaN like jnp.percentile; the sorted-order-statistics
-        # path would interpolate finite planes instead, so route those globally
-        use_dist = not bool(jnp.isnan(work).any())
+        # path would interpolate finite planes instead, so route those globally.
+        # The reduction runs on the padded physical (pad slots are finite zeros).
+        use_dist = not bool(jnp.isnan(x.parray).any())
     if use_dist:
-        sv, _ = dist_sort.distributed_sort(x.comm, x.comm.shard(work, x.split), eff_axis)
+        n_log = x.gshape[eff_axis]
+        work = x.comm.shard(x.parray.astype(promoted), x.split)  # stays 1/P-local
+        sv, _ = dist_sort.distributed_sort(
+            x.comm, work, eff_axis, logical_n=n_log
+        )
         result = _percentile_from_sorted(
-            sv, q_arr, eff_axis, interpolation, keepdims
+            sv, q_arr, eff_axis, interpolation, keepdims, n=n_log
         )
         if axis_s is None:  # scalar-q + axis=None conventions already match (ndim-1 case)
             axis_s = eff_axis
     else:
         result = jnp.percentile(
-            work,
+            x.larray.astype(promoted),
             q_arr,
             axis=axis_s,
             method=interpolation,
