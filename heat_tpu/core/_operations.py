@@ -36,6 +36,75 @@ __all__ = ["binary_op", "local_op", "reduce_op", "cum_op", "wrap_result", "handl
 Scalar = (int, float, bool, complex, np.number, np.bool_)
 
 
+# --------------------------------------------------------------------- padded layout
+# Ragged split extents (n % P != 0) are stored physically padded to ceil(n/P)*P so
+# shards are a true 1/P (SURVEY §7; DNDarray.parray). ``larray`` on such an array
+# eagerly slices the padding off, which GSPMD resolves to a REPLICATED value — O(n)
+# per device. The wrappers below therefore compute directly on the padded physical
+# value whenever the operand pattern allows it, so ragged compute is O(n/P) like the
+# reference's chunk-local ops (reference ``_operations.py:22-227``).
+#
+# Physical invariant: **pad slots always hold zero.** ``comm.shard`` zero-pads, and
+# every padded-path op re-masks its result (one ``where`` against a length-m iota —
+# XLA fuses it into the producing op, so pads never round-trip through HBM as
+# garbage). Guards like ``jnp.isnan(x.parray).any()`` stay exact under it.
+
+
+def _pad_mask(physical_shape, n: int, split: int):
+    """Boolean mask, broadcast-shaped ``(1,..,m,..,1)``: True on logical slots along
+    the padded split dimension."""
+    shape = [1] * len(physical_shape)
+    shape[split] = physical_shape[split]
+    return (jnp.arange(physical_shape[split]) < n).reshape(shape)
+
+
+def _zero_pads(value, gshape, split: int):
+    """Restore the clean-pad invariant after computing on a padded physical value."""
+    mask = _pad_mask(value.shape, gshape[split], split)
+    return jnp.where(mask, value, jnp.zeros((), value.dtype))
+
+
+def _is_complexish(*ts) -> bool:
+    for t in ts:
+        if isinstance(t, DNDarray) and jnp.issubdtype(t.dtype.jax_type(), jnp.complexfloating):
+            return True
+        if isinstance(t, complex) and not isinstance(t, bool):
+            return True
+    return False
+
+
+def _padded_physical_operands(pair, out_shape, out_split, comm):
+    """Physical (padded) operand values for the ragged binary fast path, or ``None``
+    when this operand pattern can't ride it. Each operand is either
+
+    - a scalar (broadcasts over pads harmlessly),
+    - full-extent along the out split dim → its padded physical value (``parray`` if
+      already laid out, else ``comm.shard`` pads it into the layout), or
+    - broadcast along the out split dim (dim absent or extent 1) and itself unpadded
+      → its logical value.
+    """
+    nd = len(out_shape)
+    ops = []
+    for t, arr in pair:
+        if np.isscalar(t):
+            ops.append(t)
+            continue
+        pos = out_split - (nd - arr.ndim)
+        if pos >= 0 and pos < arr.ndim and arr.gshape[pos] == out_shape[out_split]:
+            if arr._is_padded():
+                if arr.split == pos:
+                    ops.append(arr.parray)
+                    continue
+                return None  # padded along a different dim: no cheap physical form
+            ops.append(comm.shard(arr.larray, pos))
+            continue
+        if (pos < 0 or arr.gshape[pos] == 1) and not arr._is_padded():
+            ops.append(arr.larray)
+            continue
+        return None
+    return ops
+
+
 def _ensure_dndarray(x, device=None, comm=None) -> DNDarray:
     from . import factories
 
@@ -159,6 +228,31 @@ def binary_op(
 
     out_shape = broadcast_shapes(a.gshape, b.gshape)
     out_split = _out_split_binary(out_shape, a, b)
+    use_comm = comm or get_comm()
+
+    # ragged fast path: compute on the padded physical values so per-device memory
+    # stays O(n/P) (the logical slice below resolves to a replicated value)
+    if (
+        out is None
+        and where is None
+        and out_split is not None
+        and use_comm.padded_dim(out_shape[out_split]) != out_shape[out_split]
+        and not _is_complexish(t1, t2, a, b)
+    ):
+        phys = _padded_physical_operands(((t1, a), (t2, b)), out_shape, out_split, use_comm)
+        if phys is not None:
+            result = operation(phys[0], phys[1], **fn_kwargs)
+            result = _zero_pads(result, out_shape, out_split)
+            result = use_comm.shard(result, out_split)
+            return DNDarray(
+                result,
+                out_shape,
+                types.canonical_heat_type(result.dtype),
+                out_split,
+                device or get_device(),
+                use_comm,
+                True,
+            )
 
     # promote: scalars stay weakly typed so jnp's promotion matches numpy/heat
     x1 = a.larray if not np.isscalar(t1) else t1
@@ -179,7 +273,6 @@ def binary_op(
                     base = jnp.zeros(out_shape, result.dtype)
                 result = jnp.where(w, result, base)
 
-    use_comm = comm or get_comm()
     if out is not None:
         sanitation.sanitize_out(out, out_shape, out_split, device)
         result = use_comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)
@@ -202,6 +295,24 @@ def local_op(
 ) -> DNDarray:
     """Elementwise operation, no communication (reference ``__local_op`` ``:331``)."""
     sanitation.sanitize_in(x)
+    if x._is_padded() and out is None and not _is_complexish(x):
+        # ragged fast path: elementwise on the padded physical value keeps shards 1/P;
+        # pad slots compute garbage in registers and are re-zeroed by the fused mask
+        result = operation(x.parray, **fn_kwargs)
+        if tuple(result.shape) == tuple(x.parray.shape) and not jnp.issubdtype(
+            result.dtype, jnp.complexfloating
+        ):
+            result = _zero_pads(result, x.gshape, x.split)
+            result = x.comm.shard(result, x.split)
+            return DNDarray(
+                result,
+                x.gshape,
+                types.canonical_heat_type(result.dtype),
+                x.split,
+                x.device,
+                x.comm,
+                x.balanced,
+            )
     result = operation(x.larray, **fn_kwargs)
     if out is not None:
         sanitation.sanitize_out(out, x.gshape, x.split, x.device)
@@ -230,6 +341,92 @@ def _out_split_reduce(
     return x.split - sum(1 for ax in axes if ax < x.split)
 
 
+_REDUCE_NEUTRAL = {
+    jnp.sum: "zero",
+    jnp.nansum: "zero",
+    jnp.any: "zero",
+    jnp.prod: "one",
+    jnp.nanprod: "one",
+    jnp.all: "one",
+    jnp.max: "lowest",
+    jnp.nanmax: "lowest",
+    jnp.min: "highest",
+    jnp.nanmin: "highest",
+}
+
+
+def _neutral_scalar(kind: str, dtype):
+    """The identity element of a reduction for ``dtype`` (reference neutral-element
+    table for empty shards, ``_operations.py:450-459``; here it fills pad slots)."""
+    if kind == "zero":
+        return jnp.zeros((), dtype)
+    if kind == "one":
+        return jnp.ones((), dtype)
+    if jnp.issubdtype(dtype, jnp.bool_):
+        return jnp.asarray(kind == "highest", bool)
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        return jnp.asarray(info.min if kind == "lowest" else info.max, dtype)
+    return jnp.asarray(-jnp.inf if kind == "lowest" else jnp.inf, dtype)
+
+
+def _padded_reduce(operation, x: DNDarray, axis, out_split, keepdims, fn_kwargs):
+    """Reduce a padded-physical array without materialising the logical (replicated)
+    value — or return None when ``operation`` has no pad-safe form. Mean/std/var get
+    count-corrected forms (pad slots must not inflate the element count)."""
+    axes = tuple(range(x.ndim)) if axis is None else (axis if isinstance(axis, tuple) else (axis,))
+    phys = x.parray
+    split = x.split
+    if split not in axes:
+        # the padded dim survives: pad rows reduce to garbage in output pad slots,
+        # which the mask re-zeroes; logical slots never mix with pads
+        if out_split is None:
+            return None
+        result = operation(phys, axis=axis, keepdims=keepdims, **fn_kwargs)
+        if keepdims:
+            out_shape = tuple(1 if i in axes else s for i, s in enumerate(x.gshape))
+        else:
+            out_shape = tuple(s for i, s in enumerate(x.gshape) if i not in axes)
+        if out_split >= len(out_shape):
+            return None
+        expected = out_shape[:out_split] + (phys.shape[split],) + out_shape[out_split + 1 :]
+        if tuple(result.shape) != expected:
+            return None
+        result = _zero_pads(result, out_shape, out_split)
+        result = x.comm.shard(result, out_split)
+        return DNDarray(
+            result, out_shape, types.canonical_heat_type(result.dtype), out_split,
+            x.device, x.comm, True,
+        )
+    # the padded dim is reduced away: fill pad slots with the op's neutral element
+    mask = _pad_mask(phys.shape, x.gshape[split], split)
+    n_count = int(np.prod([x.gshape[ax] for ax in axes])) if axes else 1
+    m_count = int(np.prod([phys.shape[ax] for ax in axes])) if axes else 1
+    if operation is jnp.mean:
+        masked0 = jnp.where(mask, phys, jnp.zeros((), phys.dtype))
+        result = jnp.mean(masked0, axis=axis, keepdims=keepdims, **fn_kwargs) * (
+            m_count / n_count
+        )
+    elif operation in (jnp.std, jnp.var):
+        masked0 = jnp.where(mask, phys, jnp.zeros((), phys.dtype))
+        mu = jnp.mean(masked0, axis=axis, keepdims=True) * (m_count / n_count)
+        d = jnp.where(mask, phys.astype(mu.dtype) - mu, jnp.zeros((), mu.dtype))
+        ddof = fn_kwargs.get("ddof", 0)
+        v = jnp.sum(d * d, axis=axis, keepdims=keepdims) / (n_count - ddof)
+        result = jnp.sqrt(v) if operation is jnp.std else v
+    else:
+        kind = _REDUCE_NEUTRAL.get(operation)
+        if kind is None:
+            return None
+        masked = jnp.where(mask, phys, _neutral_scalar(kind, phys.dtype))
+        result = operation(masked, axis=axis, keepdims=keepdims, **fn_kwargs)
+    result = x.comm.shard(result, out_split)
+    return DNDarray(
+        result, tuple(result.shape), types.canonical_heat_type(result.dtype), out_split,
+        x.device, x.comm, True,
+    )
+
+
 def reduce_op(
     operation: Callable,
     x: DNDarray,
@@ -247,6 +444,10 @@ def reduce_op(
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.gshape, axis)
     out_split = _out_split_reduce(x, axis, keepdims)
+    if x._is_padded() and out is None:
+        res = _padded_reduce(operation, x, axis, out_split, keepdims, fn_kwargs)
+        if res is not None:
+            return res
     result = operation(x.larray, axis=axis, keepdims=keepdims, **fn_kwargs)
     out_shape = tuple(result.shape)
     if out_split is not None and out_split >= len(out_shape):
@@ -275,11 +476,27 @@ def cum_op(
     axis = sanitize_axis(x.gshape, axis)
     if axis is None:
         raise NotImplementedError("cumulative operations require an explicit axis")
+    target = types.canonical_heat_type(dtype).jax_type() if dtype is not None else None
+    if (
+        x._is_padded()
+        and out is None
+        and (target is None or not jnp.issubdtype(target, jnp.complexfloating))
+    ):
+        # ragged fast path: layout padding sits at the END of the global split dim, so
+        # a prefix op along any axis never reads pad slots before logical ones
+        value = x.parray if target is None else _safe_astype(x.parray, target)
+        result = operation(value, axis=axis, **fn_kwargs)
+        result = _zero_pads(result, x.gshape, x.split)
+        result = x.comm.shard(result, x.split)
+        return DNDarray(
+            result, x.gshape, types.canonical_heat_type(result.dtype), x.split,
+            x.device, x.comm, x.balanced,
+        )
     value = x.larray
-    if dtype is not None:
+    if target is not None:
         # numpy semantics: dtype is the ACCUMULATOR type — cast before the scan so
         # e.g. an int8 cumsum with dtype=int64 accumulates without overflow
-        value = _safe_astype(value, types.canonical_heat_type(dtype).jax_type())
+        value = _safe_astype(value, target)
     result = operation(value, axis=axis, **fn_kwargs)
     if out is not None:
         sanitation.sanitize_out(out, x.gshape, x.split, x.device)

@@ -296,8 +296,12 @@ class MeshCommunication(Communication):
                 # for this dtype and the split is metadata only
                 return jax.device_put(array, cpu_fallback_device())
         target = self.sharding(array.ndim, split)
-        if isinstance(array, jax.Array) and array.sharding == target:
-            return array
+        if isinstance(array, jax.Array):
+            try:
+                if array.sharding == target:
+                    return array
+            except AttributeError:
+                pass  # tracer under jit: device_put below becomes a sharding constraint
         ragged = split is not None and array.shape[split] % self.size != 0
         if jax.process_count() > 1:
             # multi-controller: a host value can only populate addressable shards —

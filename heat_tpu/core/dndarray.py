@@ -136,14 +136,30 @@ class DNDarray:
         """Rebind the payload (reference setter ``dndarray.py:146-168``).
 
         Accepts either a logical-shape value or the padded physical form of the
-        *current* gshape (as produced by ``comm.shard``); any other shape rebinds the
-        logical gshape to the value's shape."""
+        *current* gshape (as produced by ``comm.shard``). The padded interpretation
+        only applies when the value is actually laid out in the split's sharding —
+        a host/replicated value whose shape merely coincides with the padded extent
+        rebinds the logical gshape to the value's shape instead."""
         if not isinstance(array, jax.Array):
             raise TypeError(f"larray must be a jax.Array, got {type(array)}")
+        shape = tuple(array.shape)
+        if shape != self.__gshape and not (
+            shape == self._padded_gshape() and self._sharding_matches(array)
+        ):
+            self.__gshape = shape
         self.__array = array
-        if tuple(array.shape) != self._padded_gshape():
-            self.__gshape = tuple(array.shape)
         self.__dtype = types.canonical_heat_type(array.dtype)
+
+    def _sharding_matches(self, array: jax.Array) -> bool:
+        """Whether ``array`` carries the communicator's sharding for this split."""
+        try:
+            return array.sharding == self.__comm.sharding(array.ndim, self.__split)
+        except AttributeError:
+            # tracer under jit: internal padded rebinds come from comm.shard, whose
+            # device_put lowers to exactly this sharding — treat as a match
+            return True
+        except Exception:
+            return False
 
     @property
     def parray(self) -> jax.Array:

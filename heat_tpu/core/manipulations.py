@@ -369,6 +369,11 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
             logical_n=a.gshape[axis],
         )
         indices = indices.astype(jnp.int64)
+        if values.shape[axis] != a.gshape[axis]:
+            # distributed_sort pads with sort sentinels (NaN / dtype extrema); the
+            # DNDarray layout contract is zero pads (guards probe parray directly)
+            values = _operations._zero_pads(values, a.gshape, a.split)
+            indices = _operations._zero_pads(indices, a.gshape, a.split)
         v = DNDarray(values, a.gshape, types.canonical_heat_type(values.dtype),
                      a.split, a.device, a.comm, True)
         i = DNDarray(indices, a.gshape, types.canonical_heat_type(indices.dtype),
@@ -454,6 +459,60 @@ def tile(x: DNDarray, reps: Sequence[int]) -> DNDarray:
     return _wrap(result, x, split)
 
 
+def _topk_split(a: DNDarray, k: int, dim: int, largest: bool):
+    """Distributed top-k along the split axis (reference ``mpi_topk``
+    ``manipulations.py:4137``): each shard selects its own k candidates locally
+    (O(n/P)), a tiled all-gather moves only P·k candidates (k·P ≪ n), and the final
+    k are chosen from those — the reference's candidate-reduction scheme on XLA
+    collectives. Smallest-k avoids negation (INT_MIN/unsigned-safe) via a plain
+    ascending argsort of the shard, mirroring the global fallback path.
+
+    Tie order matches the global path (lowest global index wins): per-shard
+    selections are index-ascending among equal values, the gather is shard-major,
+    and the final stable argsort preserves that order.
+    """
+    comm = a.comm
+    phys = a.parray
+    c = phys.shape[dim] // comm.size
+    n = a.gshape[dim]
+    nd = phys.ndim
+    last = nd - 1
+
+    def block(x):
+        r = jax.lax.axis_index(comm.axis_name)
+        xm = jnp.moveaxis(x, dim, -1)
+        gidx = r * c + jnp.arange(c)
+        valid = gidx < n  # exclude layout-padding slots from candidacy
+        if largest:
+            info = jnp.iinfo(xm.dtype) if jnp.issubdtype(xm.dtype, jnp.integer) else None
+            sent = info.min if info else -jnp.inf
+            xv = jnp.where(valid, xm, jnp.asarray(sent, xm.dtype))
+            vals, li = jax.lax.top_k(xv, k)
+        else:
+            info = jnp.iinfo(xm.dtype) if jnp.issubdtype(xm.dtype, jnp.integer) else None
+            sent = info.max if info else jnp.inf
+            xv = jnp.where(valid, xm, jnp.asarray(sent, xm.dtype))
+            li = jnp.argsort(xv, axis=-1)[..., :k]
+            vals = jnp.take_along_axis(xv, li, axis=-1)
+        gi = li + r * c
+        cv = jax.lax.all_gather(vals, comm.axis_name, axis=last, tiled=True)
+        ci = jax.lax.all_gather(gi, comm.axis_name, axis=last, tiled=True)
+        sel = jnp.argsort(cv, axis=-1, descending=largest, stable=True)[..., :k]
+        fv = jnp.take_along_axis(cv, sel, axis=-1)
+        fi = jnp.take_along_axis(ci, sel, axis=-1)
+        return jnp.moveaxis(fv, -1, dim), jnp.moveaxis(fi, -1, dim)
+
+    from jax.sharding import PartitionSpec
+
+    rep = PartitionSpec(*([None] * nd))
+    values, idx = jax.shard_map(
+        block, mesh=comm.mesh, in_specs=(comm.spec(nd, dim),), out_specs=(rep, rep),
+        check_vma=False,  # outputs ARE replicated (post-all_gather) but the static
+        # varying-manual-axes analysis cannot see through the final take_along_axis
+    )(phys)
+    return values, idx
+
+
 def topk(
     a: DNDarray,
     k: int,
@@ -463,12 +522,31 @@ def topk(
     out=None,
 ):
     """k largest/smallest entries along ``dim``; returns ``(values, indices)``
-    (reference ``manipulations.py:3982`` with its custom ``mpi_topk`` reduction op — here
-    a global top-k XLA lowers directly)."""
+    (reference ``manipulations.py:3982``). Along a split ``dim`` this is the
+    candidate-reduction scheme of the reference's ``mpi_topk`` (per-shard top-k +
+    P·k-candidate gather — O(n/P + k·P) per device); otherwise a global top-k XLA
+    lowers directly."""
     sanitation.sanitize_in(a)
     dim = sanitize_axis(a.gshape, dim)
     if k > a.gshape[dim]:
         raise ValueError(f"selected index k={k} out of range for dimension of size {a.gshape[dim]}")
+    if (
+        a.split == dim
+        and a.comm.is_distributed()
+        and len(a.comm.axis_names) == 1
+        and a.comm.size > 1
+        and k <= a.parray.shape[dim] // a.comm.size
+        and jnp.issubdtype(a.parray.dtype, jnp.number)
+        and not jnp.issubdtype(a.parray.dtype, jnp.complexfloating)
+    ):
+        values, idx = _topk_split(a, k, dim, largest)
+        split = None  # the k results are replicated, like the reference's final bcast
+        v = _wrap(values, a, split)
+        i = _wrap(idx.astype(jnp.int64), a, split)
+        if out is not None:
+            out_v, out_i = out
+            return _handle_out(v, out_v, a), _handle_out(i, out_i, a)
+        return v, i
     x = jnp.moveaxis(a.larray, dim, -1)
     if largest:
         values, idx = jax.lax.top_k(x, k)
@@ -531,17 +609,26 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
         axis is None
         and a.split is not None
         and a.comm.is_distributed()
-        and a.larray.size >= a.comm.size
+        and a.size >= a.comm.size
     )
-    if use_partials and jnp.issubdtype(a.larray.dtype, jnp.floating):
+    if use_partials and jnp.issubdtype(a.parray.dtype, jnp.floating):
         # NaN != NaN breaks the searchsorted inverse and partial-merge dedup; route
-        # arrays containing NaNs through the global path
-        use_partials = not bool(jnp.isnan(a.larray).any())
+        # arrays containing NaNs through the global path. The probe runs on the
+        # padded physical value (pad slots are zero, never NaN) so it stays O(n/P).
+        use_partials = not bool(jnp.isnan(a.parray).any())
     if use_partials:
         result = jnp.asarray(_partial_unique_values(a))
         if return_inverse:
-            inverse = jnp.searchsorted(result, a.larray).astype(jnp.int64)
-            return _wrap(result, a, None), _wrap(inverse, a, None)
+            # searchsorted on the padded physical keeps the inverse O(n/P); it
+            # inherits the input's split like the reference's local inverses
+            inverse = jnp.searchsorted(result, a.parray).astype(jnp.int64)
+            if a._is_padded():
+                inverse = _operations._zero_pads(inverse, a.gshape, a.split)
+            inv = DNDarray(
+                a.comm.shard(inverse, a.split), a.gshape,
+                types.canonical_heat_type(inverse.dtype), a.split, a.device, a.comm, True,
+            )
+            return _wrap(result, a, None), inv
         return _wrap(result, a, None)
     if return_inverse:
         result, inverse = jnp.unique(a.larray, return_inverse=True, axis=axis)

@@ -14,7 +14,9 @@ def copy(x: DNDarray) -> DNDarray:
     immutable, so this is a metadata-fresh wrapper over the same buffers."""
     if not isinstance(x, DNDarray):
         raise TypeError(f"input needs to be a DNDarray, got {type(x)}")
-    return DNDarray(x.larray, x.gshape, x.dtype, x.split, x.device, x.comm, x.balanced)
+    # parray, not larray: slicing a ragged array's padding off resolves to a
+    # replicated value — the copy must keep the 1/P padded physical layout
+    return DNDarray(x.parray, x.gshape, x.dtype, x.split, x.device, x.comm, x.balanced)
 
 
 def sanitize_memory_layout(x, order: str = "C"):

@@ -289,7 +289,10 @@ def _percentile_from_sorted(sv, q_arr, axis, method, keepdims, n=None):
     elif method == "higher":
         r = jnp.take(sv, hi, axis=axis)
     elif method == "nearest":
-        r = jnp.take(sv, jnp.clip(jnp.rint(pos), 0, n - 1).astype(jnp.int32), axis=axis)
+        # jnp.percentile's tie rule: the LOWER bracket wins at frac == 0.5 exactly
+        # (jnp.rint's round-half-even gave layout-dependent answers — ADVICE r4)
+        nearest = jnp.where(pos - lo <= 0.5, lo, hi)
+        r = jnp.take(sv, jnp.clip(nearest, 0, n - 1).astype(jnp.int32), axis=axis)
     elif method == "midpoint":
         r = (jnp.take(sv, lo, axis=axis) + jnp.take(sv, hi, axis=axis)) / 2
     else:  # linear

@@ -1,0 +1,139 @@
+"""Distributed top-k (VERDICT r4 #6): per-shard top-k + P·k candidate gather along
+the split dim — the reference's ``mpi_topk`` candidate-reduction
+(``/root/reference/heat/core/manipulations.py:3982,4137``) on XLA collectives.
+Memory proof mirrors tests/test_dist_sort.py: no full-size buffer per device."""
+
+import unittest
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core.dndarray import DNDarray
+
+
+def np_topk(a, k, axis, largest):
+    """Oracle with the framework's tie rule: lowest original index wins."""
+    am = np.moveaxis(a, axis, -1)
+    if largest:
+        if np.issubdtype(am.dtype, np.integer):
+            # negation can overflow the input dtype: lexsort (value desc, index asc)
+            # on a widened copy per row
+            flat = am.reshape(-1, am.shape[-1])
+            order = np.stack(
+                [np.lexsort((np.arange(r.size), -r.astype(np.int64))) for r in flat]
+            ).reshape(am.shape)
+        else:
+            order = np.argsort(-am.astype(np.float64), axis=-1, kind="stable")
+        idx = order[..., :k]
+    else:
+        idx = np.argsort(am, axis=-1, kind="stable")[..., :k]
+    vals = np.take_along_axis(am, idx, axis=-1)
+    return np.moveaxis(vals, -1, axis), np.moveaxis(idx, -1, axis)
+
+
+class TestDistributedTopk(unittest.TestCase):
+    @property
+    def comm(self):
+        return ht.core.communication.get_comm()
+
+    def check(self, a, k, dim, largest):
+        x = ht.array(a, split=dim)
+        v, i = ht.topk(x, k, dim=dim, largest=largest)
+        wv, wi = np_topk(a, k, dim, largest)
+        np.testing.assert_array_equal(v.numpy(), wv, err_msg=f"values k={k} dim={dim} largest={largest}")
+        np.testing.assert_array_equal(i.numpy(), wi, err_msg=f"indices k={k} dim={dim} largest={largest}")
+
+    def test_1d_float(self):
+        P = self.comm.size
+        rng = np.random.default_rng(0)
+        for n in (16 * P, 16 * P + 3):  # divisible and ragged
+            a = rng.standard_normal(n).astype(np.float32)
+            for k in (1, 5, 16):
+                for largest in (True, False):
+                    self.check(a, k, 0, largest)
+
+    def test_ties_match_global_tie_rule(self):
+        P = self.comm.size
+        n = 8 * P + 1
+        a = np.asarray([1.0, 2.0] * (n // 2) + [2.0], np.float32)  # heavy duplicates
+        self.check(a, 5, 0, True)
+        self.check(a, 5, 0, False)
+
+    def test_int_extremes_and_unsigned(self):
+        P = self.comm.size
+        n = 8 * P
+        rng = np.random.default_rng(1)
+        ai = rng.integers(-50, 50, n).astype(np.int32)
+        ai[[0, 3]] = np.iinfo(np.int32).min  # negation would overflow these
+        ai[[5, 9]] = np.iinfo(np.int32).max
+        for largest in (True, False):
+            self.check(ai, 6, 0, largest)
+        au = rng.integers(0, 100, n).astype(np.uint8)  # heat's one unsigned dtype
+        for largest in (True, False):
+            self.check(au, 4, 0, largest)
+
+    def test_2d_both_dims(self):
+        P = self.comm.size
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((4 * P + 2, 6)).astype(np.float32)
+        self.check(a, 3, 0, True)   # split dim
+        self.check(a, 3, 0, False)
+        x = ht.array(a, split=0)    # topk along NON-split dim stays per-shard local
+        v, i = ht.topk(x, 2, dim=1)
+        wv, wi = np_topk(a, 2, 1, True)
+        np.testing.assert_array_equal(v.numpy(), wv)
+        np.testing.assert_array_equal(i.numpy(), wi)
+
+    def test_k_larger_than_shard_falls_back(self):
+        P = self.comm.size
+        n = 4 * P
+        a = np.random.default_rng(3).standard_normal(n).astype(np.float32)
+        self.check(a, n - 1, 0, True)  # k > c: global fallback still correct
+
+    def test_out_param(self):
+        P = self.comm.size
+        n = 8 * P
+        a = np.random.default_rng(4).standard_normal(n).astype(np.float32)
+        x = ht.array(a, split=0)
+        v0, i0 = ht.topk(x, 3)
+        out_v = ht.zeros(3, dtype=ht.float32)
+        out_i = ht.zeros(3, dtype=ht.int64)
+        v, i = ht.topk(x, 3, out=(out_v, out_i))
+        np.testing.assert_array_equal(v.numpy(), v0.numpy())
+        np.testing.assert_array_equal(out_v.numpy(), v0.numpy())
+        np.testing.assert_array_equal(out_i.numpy(), i0.numpy())
+
+    def test_compiles_shard_local(self):
+        comm = self.comm
+        P = comm.size
+        if P == 1 or comm.mesh is None:
+            self.skipTest("needs a distributed mesh")
+        n = 8192 * P + 3
+        c = -(-n // P)
+        k = 16
+        x = ht.array(np.random.default_rng(5).standard_normal(n).astype(np.float32), split=0)
+
+        def f(p):
+            d = DNDarray(p, (n,), ht.float32, 0, x.device, comm, True)
+            v, i = ht.topk(d, k)
+            return v.larray, i.larray
+
+        compiled = jax.jit(f).lower(x.parray).compile()
+        hlo = compiled.as_text()
+        ma = compiled.memory_analysis()
+        shard_bytes = c * 4
+        global_bytes = n * 4
+        # the only gather is the P*k candidate exchange, not the array
+        self.assertLess(ma.temp_size_in_bytes, global_bytes)
+        self.assertLessEqual(ma.argument_size_in_bytes, 2 * shard_bytes)
+        v, i = f(x.parray)
+        wv, wi = np_topk(np.asarray(jax.device_get(x.larray)), k, 0, True)
+        np.testing.assert_array_equal(np.asarray(v), wv)
+        np.testing.assert_array_equal(np.asarray(i), wi)
+
+
+if __name__ == "__main__":
+    unittest.main()
