@@ -56,18 +56,39 @@ except ImportError:
     _HAS_ZARR = False
 
 
-def _is_writer() -> bool:
-    """Multi-controller contract: process 0 is the single writer.
+_VALID_WRITE_MODES = frozenset(["w", "a", "r+"])
 
-    The reference writes per-rank hyperslabs through parallel HDF5/MPI-IO when
-    available and serializes otherwise (``io.py:46-49``). Plain h5py/netCDF4/numpy
-    writers cannot coordinate concurrent writes to one file, so under
-    ``jax.process_count() > 1`` every process gathers the global value (see
-    ``DNDarray.numpy``) and only process 0 touches the filesystem.
+
+def _is_writer() -> bool:
+    """Multi-controller contract: process 0 creates files / writes unsplit data.
+
+    Split data is written per-shard by every process in serialized rounds
+    (:func:`_serialized_shard_write`) — the reference's no-MPI-IO scheme of
+    rank-by-rank hyperslab writes (``io.py:231-238``); only formats that cannot
+    target hyperslabs (csv/npy) gather to this single writer.
     """
     import jax
 
     return jax.process_index() == 0
+
+
+def _serialized_shard_write(tag: str, write_my_shards) -> None:
+    """Each controller writes its ADDRESSABLE shards, one process at a time
+    (reference ``io.py:231-238``: ``Recv`` from the previous rank, write own
+    hyperslab, ``Isend`` to the next — here the token ring is a barrier round).
+    Host memory per process stays O(local shards); no global gather."""
+    import jax
+
+    nproc = jax.process_count()
+    if nproc == 1:
+        write_my_shards()
+        return
+    from jax.experimental import multihost_utils
+
+    for p in range(nproc):
+        if jax.process_index() == p:
+            write_my_shards()
+        multihost_utils.sync_global_devices(f"heat_tpu.io:{tag}:round{p}")
 
 
 def _writer_barrier(tag: str) -> None:
@@ -265,27 +286,90 @@ if _HAS_HDF5:
         return factories.array(value, dtype=dtype, split=split, device=device, comm=comm)
 
     def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
-        """Save to an HDF5 dataset (reference ``io.py:167``): per-shard hyperslab
-        writes."""
+        """Save to an HDF5 dataset (reference ``io.py:167-238``): per-shard hyperslab
+        writes. Multi-controller jobs serialize rank-by-rank like the reference's
+        no-MPI-IO path — each process writes only its addressable shards; the global
+        array is never gathered."""
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
         if not isinstance(path, str):
             raise TypeError(f"path must be str, not {type(path)}")
-        if not data.larray.is_fully_addressable:
-            # multi-controller: gather, single writer (see _is_writer)
-            value = data.numpy()
+        if mode not in _VALID_WRITE_MODES:
+            raise ValueError(f"mode was {mode}, not in possible modes {_VALID_WRITE_MODES}")
+        np_dtype = np.dtype(data.dtype.jax_type())
+        if not data.parray.is_fully_addressable:
+            # process 0 creates the dataset, then serialized per-process slab rounds
             if _is_writer():
                 with h5py.File(path, mode) as handle:
-                    handle.create_dataset(dataset, data=value, **kwargs)
-            _writer_barrier(f"save_hdf5:{path}")
+                    handle.create_dataset(dataset, data.gshape, dtype=np_dtype, **kwargs)
+            _writer_barrier(f"save_hdf5:create:{path}")
+
+            def write_my_shards():
+                with h5py.File(path, "r+") as handle:
+                    dset = handle[dataset]
+                    for index, value in data.iter_shards():
+                        dset[index] = np.asarray(value)
+
+            _serialized_shard_write(f"save_hdf5:{path}", write_my_shards)
             return
         with h5py.File(path, mode) as handle:
-            dset = handle.create_dataset(dataset, data.gshape, dtype=np.dtype(data.dtype.jax_type()), **kwargs)
+            dset = handle.create_dataset(dataset, data.gshape, dtype=np_dtype, **kwargs)
             if data.split is None:
                 dset[...] = np.asarray(data.larray)
             else:
                 for index, value in data.iter_shards():
                     dset[index] = np.asarray(value)
+
+
+def _netcdf_has_fancy_keys(file_slices) -> bool:
+    """True when ``file_slices`` contains anything but plain forward slices /
+    Ellipsis — such keys take the whole-variable write path. Decidable without
+    opening the file, so multi-controller jobs can pick their collective path
+    consistently BEFORE any serialized per-process round."""
+    keys = file_slices if isinstance(file_slices, tuple) else (file_slices,)
+    return any(
+        not (k is Ellipsis or (isinstance(k, slice) and (k.step is None or k.step > 0)))
+        for k in keys
+    )
+
+
+def _compose_netcdf_slices(file_slices, gshape, var_shape, unlimited):
+    """Resolve ``file_slices`` into one ``range`` per variable dimension mapping
+    data indices to file indices, or ``None`` when the keys cannot address the
+    data per-shard (fancy keys, extent mismatch, or overrun of a LIMITED
+    dimension). Unlimited dimensions may address past the current extent — that
+    is the append."""
+    nd = len(var_shape)
+    if len(gshape) != nd:
+        return None  # dim-count mismatch (e.g. 1-d data into a 2-d variable)
+    if not isinstance(file_slices, tuple):
+        file_slices = (file_slices,)
+    if Ellipsis in file_slices:
+        i = file_slices.index(Ellipsis)
+        fill = nd - (len(file_slices) - 1)
+        file_slices = file_slices[:i] + (slice(None),) * fill + file_slices[i + 1 :]
+    file_slices = file_slices + (slice(None),) * (nd - len(file_slices))
+    if len(file_slices) != nd or _netcdf_has_fancy_keys(file_slices):
+        return None
+    ranges = []
+    for d, (fs, vs) in enumerate(zip(file_slices, var_shape)):
+        step = fs.step if fs.step is not None else 1
+        start = fs.start if fs.start is not None else 0
+        if start < 0:
+            start += vs
+        if fs.stop is None:
+            # cover the data extent exactly; on an unlimited dimension this may
+            # grow the file
+            stop = start + step * gshape[d]
+        else:
+            stop = fs.stop + vs if fs.stop < 0 else fs.stop
+        rng = range(start, stop, step)
+        if len(rng) != gshape[d]:
+            return None  # keys must address exactly the data's extent
+        if not unlimited[d] and rng and rng[-1] >= vs:
+            return None  # writing past the end of a limited dimension
+        ranges.append(rng)
+    return ranges
 
 
 if _HAS_NETCDF:
@@ -313,21 +397,134 @@ if _HAS_NETCDF:
             value = _sharded_read(data, gshape, np_dtype, split, comm)
         return factories.array(value, dtype=dtype, split=split, device=device, comm=comm)
 
-    def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
-        """Save to a NetCDF variable (reference ``io.py:367``)."""
+    def save_netcdf(
+        data: DNDarray,
+        path: str,
+        variable: str,
+        mode: str = "w",
+        dimension_names=None,
+        is_unlimited: bool = False,
+        file_slices=slice(None),
+        **kwargs,
+    ) -> None:
+        """Save to a NetCDF variable (reference ``io.py:367-571``).
+
+        Writes are per-shard hyperslabs through ``iter_shards`` — never a global
+        gather; multi-controller jobs serialize rank-by-rank
+        (:func:`_serialized_shard_write`). Append semantics match the reference:
+        ``mode='a'/'r+'`` reuses an existing variable, ``is_unlimited`` creates
+        every new dimension unlimited, and ``file_slices`` addresses the region
+        written — e.g. ``ht.save_netcdf(x, p, "v", mode="r+",
+        file_slices=slice(n, n + len(x)))`` grows an unlimited record dimension.
+        """
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
-        value = data.numpy()
-        if _is_writer():
-            with nc.Dataset(path, mode) as handle:
-                dims = []
-                for i, s in enumerate(data.gshape):
-                    name = f"dim_{variable}_{i}"
-                    handle.createDimension(name, s)
-                    dims.append(name)
-                var = handle.createVariable(variable, np.dtype(data.dtype.jax_type()), tuple(dims))
-                var[...] = value
-        _writer_barrier(f"save_netcdf:{path}")
+        if not isinstance(path, str):
+            raise TypeError(f"path must be str, not {type(path)}")
+        if not isinstance(variable, str):
+            raise TypeError(f"variable must be str, not {type(variable)}")
+        if mode not in _VALID_WRITE_MODES:
+            raise ValueError(f"mode was {mode}, not in possible modes {_VALID_WRITE_MODES}")
+        if dimension_names is None:
+            dimension_names = [f"{variable}_dim_{i}" for i in range(data.ndim)]
+        elif isinstance(dimension_names, str):
+            dimension_names = [dimension_names]
+        elif isinstance(dimension_names, tuple):
+            dimension_names = list(dimension_names)
+        elif not isinstance(dimension_names, list):
+            raise TypeError(
+                f"dimension_names must be list or tuple or string, not {type(dimension_names)}"
+            )
+        if len(dimension_names) != data.ndim:
+            raise ValueError(
+                f"{len(dimension_names)} names given for {data.ndim} dimensions"
+            )
+        np_dtype = np.dtype(data.dtype.jax_type())
+
+        def _ensure_variable(handle):
+            if variable in handle.variables:
+                return handle.variables[variable]
+            for name, size in zip(dimension_names, data.gshape):
+                if name not in handle.dimensions:
+                    handle.createDimension(name, None if is_unlimited else size)
+            return handle.createVariable(variable, np_dtype, tuple(dimension_names), **kwargs)
+
+        def _shard_writes(handle, ranges):
+            var = handle.variables[variable]
+            for index, value in data.iter_shards():
+                key = tuple(
+                    slice(r[sl.start], r[sl.stop - 1] + r.step, r.step)
+                    for r, sl in zip(ranges, index)
+                )
+                var[key] = np.asarray(value)
+
+        fancy = _netcdf_has_fancy_keys(file_slices)
+        if not data.parray.is_fully_addressable:
+            # multi-controller. Pick the path by conditions every process evaluates
+            # identically (fancy keys / unsplit data / file geometry read by all),
+            # because data.numpy() is a cross-host collective and must never run
+            # inside a one-process-at-a-time serialized round.
+            if data.split is None or fancy:
+                value = data.numpy()  # collective: all processes participate
+                if _is_writer():
+                    with nc.Dataset(path, mode) as handle:
+                        var = _ensure_variable(handle)
+                        var[file_slices] = value
+                _writer_barrier(f"save_netcdf:{path}")
+                return
+            if _is_writer():
+                with nc.Dataset(path, mode) as handle:
+                    _ensure_variable(handle)
+            _writer_barrier(f"save_netcdf:create:{path}")
+            # every process reads the (now existing) variable's geometry and
+            # resolves the same ranges, so every process takes the same branch
+            with nc.Dataset(path, "r") as handle:
+                var = handle.variables[variable]
+                var_shape = tuple(var.shape)
+                unlimited = [handle.dimensions[d].isunlimited() for d in var.dimensions]
+            if len(data.gshape) != len(var_shape):
+                # dim-count mismatch: netCDF broadcast semantics need the whole value
+                value = data.numpy()  # collective — uniform decision from the file
+                if _is_writer():
+                    with nc.Dataset(path, "r+") as handle:
+                        handle.variables[variable][file_slices] = value
+                _writer_barrier(f"save_netcdf:{path}")
+                return
+            ranges = _compose_netcdf_slices(file_slices, data.gshape, var_shape, unlimited)
+            if ranges is None:
+                raise ValueError(
+                    f"file_slices {file_slices!r} do not address the data extent "
+                    f"{data.gshape} within the variable's dimensions"
+                )
+
+            def write_my_shards():
+                with nc.Dataset(path, "r+") as handle:
+                    _shard_writes(handle, ranges)
+
+            _serialized_shard_write(f"save_netcdf:{path}", write_my_shards)
+            return
+
+        with nc.Dataset(path, mode) as handle:
+            var = _ensure_variable(handle)
+            unlimited = [handle.dimensions[d].isunlimited() for d in var.dimensions]
+            ranges = _compose_netcdf_slices(file_slices, data.gshape, var.shape, unlimited)
+            if fancy or len(data.gshape) != len(var.shape):
+                # fancy keys or netCDF broadcast across a dim-count mismatch:
+                # one whole-variable write of the logical value
+                var[file_slices] = data.numpy()
+            elif ranges is None:
+                # plain slices that don't address the data: same error as the
+                # multi-controller path (never a silent broadcast)
+                raise ValueError(
+                    f"file_slices {file_slices!r} do not address the data extent "
+                    f"{data.gshape} within the variable's dimensions"
+                )
+            elif data.split is None:
+                var[tuple(slice(r.start, r.stop, r.step) for r in ranges)] = (
+                    np.asarray(data.larray)
+                )
+            else:
+                _shard_writes(handle, ranges)
 
 
 def load_csv(

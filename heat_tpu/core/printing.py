@@ -9,6 +9,9 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
 __all__ = [
     "get_printoptions",
     "global_printing",
@@ -62,8 +65,41 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=N
             __PRINT_OPTIONS[k] = v
 
 
+def _edge_data(dndarray, edgeitems: int) -> np.ndarray:
+    """Host array holding ONLY the edgeitem slices of each summarised dimension
+    (reference ``_torch_data``, ``printing.py:208-263``, which gathers just the
+    edge slices to rank 0). Per dimension over ``2*edgeitems+1`` elements, the
+    device-side take keeps ``edgeitems`` per side plus one never-displayed filler,
+    so host transfer and host memory are O(edgeitems**ndim), not O(n). Runs on the
+    padded physical value — a ragged split never materialises its replicated trim.
+    """
+    value = dndarray.parray
+    for d, s in enumerate(dndarray.gshape):
+        if s > 2 * edgeitems + 1:
+            idx = jnp.concatenate([
+                jnp.arange(edgeitems),
+                jnp.asarray([edgeitems]),  # filler: hidden by summarisation, keeps
+                jnp.arange(s - edgeitems, s),  # the extent at 2e+1 so '...' appears
+            ])
+            value = jnp.take(value, idx, axis=d)
+        elif value.shape[d] != s:  # ragged split dim small enough to show: trim pads
+            value = jnp.take(value, jnp.arange(s), axis=d)
+    if getattr(value, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(value))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(value, tiled=True))
+
+
 def __str__(dndarray) -> str:
-    """Render a DNDarray (reference ``printing.py:184``)."""
+    """Render a DNDarray (reference ``printing.py:184``).
+
+    Large arrays (``size > threshold``) never materialise the global value: only
+    edgeitem slices are fetched (reference ``printing.py:208-263``), then numpy's
+    own summarisation renders them with the identical ``...`` layout it would use
+    on the full array (its formatter is built from the edge slices either way).
+    Small arrays route through ``DNDarray.numpy()`` — the process_allgather-aware
+    path — so multi-controller repr of a non-addressable array works too."""
     opts = __PRINT_OPTIONS
     if __LOCAL_PRINTING:
         shards = "\n".join(
@@ -73,11 +109,17 @@ def __str__(dndarray) -> str:
         return (
             f"DNDarray(local shards, gshape={dndarray.gshape}, split={dndarray.split}):\n{shards}"
         )
-    value = np.asarray(dndarray.larray)
+    summarize = dndarray.size > opts["threshold"] and dndarray.ndim > 0
+    if summarize:
+        value = _edge_data(dndarray, opts["edgeitems"])
+        threshold = 0  # the gathered corners must summarise like the full array would
+    else:
+        value = dndarray.numpy()
+        threshold = opts["threshold"]
     body = np.array2string(
         value,
         precision=opts["precision"],
-        threshold=opts["threshold"],
+        threshold=threshold,
         edgeitems=opts["edgeitems"],
         max_line_width=opts["linewidth"],
         separator=", ",

@@ -70,6 +70,13 @@ def main() -> None:
     got = a.numpy()
     np.testing.assert_array_equal(got, global_ref)
 
+    # --- repr of a non-addressable array (small and summarised) ---------------
+    r = str(a)
+    assert "DNDarray" in r and "split=0" in r, r
+    big = ht.arange(5000, split=0)
+    rb = str(big)
+    assert "..." in rb and "4999" in rb, rb  # edge slices only, with summarisation
+
     # --- is_split sanity: disagreeing non-split dims must raise ---------------
     try:
         bad_cols = cols + (1 if pid == 0 else 0)

@@ -1,0 +1,75 @@
+"""Summarize-before-gather printing (reference heat/core/printing.py:208-263):
+repr of a large array fetches only edgeitem slices — never the global value —
+and renders byte-identically to numpy's own summarised print of the full array."""
+
+import unittest
+from unittest import mock
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.core.dndarray import DNDarray
+
+
+class TestSummarizedPrinting(unittest.TestCase):
+    def body(self, a, **opts):
+        o = dict(precision=4, threshold=1000, edgeitems=3, max_line_width=120, separator=", ")
+        o.update(opts)
+        return np.array2string(a, **o)
+
+    def test_matches_numpy_summarised_repr(self):
+        cases = [
+            ((2000,), 0), ((2003,), 0), ((50, 41), 1), ((13, 7, 29), 2),
+            ((7, 2001), 1), ((5,), 0), ((0,), 0), ((6, 6), None), ((2048,), None),
+        ]
+        for shape, split in cases:
+            a = np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape) * 0.37 - 55
+            x = ht.array(a, split=split)
+            self.assertIn(self.body(a), str(x), f"shape={shape} split={split}")
+
+    def test_large_array_never_materialises_logical(self):
+        n = 200003  # ragged: the logical trim would be a replicated full buffer
+        x = ht.array(np.arange(n, dtype=np.float32), split=0)
+        calls = []
+        orig = DNDarray._logical
+
+        def spy(self):
+            calls.append(self.gshape)
+            return orig(self)
+
+        with mock.patch.object(DNDarray, "_logical", spy), \
+             mock.patch.object(DNDarray, "numpy", side_effect=AssertionError("full gather")):
+            s = str(x)
+        self.assertEqual(calls, [], "repr materialised the logical value")
+        self.assertIn("...", s)
+
+    def test_edge_gather_is_small(self):
+        x = ht.array(np.arange(100000, dtype=np.float32).reshape(100, 1000), split=1)
+        from heat_tpu.core import printing
+
+        e = printing._edge_data(x, 3)
+        self.assertEqual(e.shape, (7, 7))  # 2*edgeitems+1 per summarised dim
+
+    def test_printoptions_respected(self):
+        a = np.arange(64, dtype=np.float32)
+        x = ht.array(a, split=0)
+        ht.set_printoptions(threshold=10, edgeitems=2)
+        try:
+            s = str(x)
+            self.assertIn("...", s)
+            self.assertIn(self.body(a, threshold=10, edgeitems=2), s)
+        finally:
+            ht.set_printoptions(profile="default")
+
+    def test_local_printing_mode(self):
+        x = ht.array(np.arange(16, dtype=np.float32), split=0)
+        ht.local_printing()
+        try:
+            s = str(x)
+            self.assertIn("local shards", s)
+        finally:
+            ht.global_printing()
+
+
+if __name__ == "__main__":
+    unittest.main()
