@@ -401,15 +401,13 @@ def _padded_reduce(operation, x: DNDarray, axis, out_split, keepdims, fn_kwargs)
     # the padded dim is reduced away: fill pad slots with the op's neutral element
     mask = _pad_mask(phys.shape, x.gshape[split], split)
     n_count = int(np.prod([x.gshape[ax] for ax in axes])) if axes else 1
-    m_count = int(np.prod([phys.shape[ax] for ax in axes])) if axes else 1
     if operation is jnp.mean:
+        # sum/n, not mean*(m/n): one rounding, and exact for n == 1
         masked0 = jnp.where(mask, phys, jnp.zeros((), phys.dtype))
-        result = jnp.mean(masked0, axis=axis, keepdims=keepdims, **fn_kwargs) * (
-            m_count / n_count
-        )
+        result = jnp.sum(masked0, axis=axis, keepdims=keepdims, **fn_kwargs) / n_count
     elif operation in (jnp.std, jnp.var):
         masked0 = jnp.where(mask, phys, jnp.zeros((), phys.dtype))
-        mu = jnp.mean(masked0, axis=axis, keepdims=True) * (m_count / n_count)
+        mu = jnp.sum(masked0, axis=axis, keepdims=True) / n_count
         d = jnp.where(mask, phys.astype(mu.dtype) - mu, jnp.zeros((), mu.dtype))
         ddof = fn_kwargs.get("ddof", 0)
         v = jnp.sum(d * d, axis=axis, keepdims=keepdims) / (n_count - ddof)
