@@ -32,3 +32,37 @@ def concatenate():
 def resplit_bench():
     a = ht.random.random((1000, N // 1000), split=0)
     return a.resplit(1).larray
+
+
+# --- sort family (VERDICT r4 #8: the merge-split network had no cb entry) --------
+
+@monitor("sort_split0")
+def sort_split0():
+    a = ht.random.random((N,), split=0)
+    v, _ = ht.sort(a, axis=0)
+    return v.parray
+
+
+@monitor("topk_split0")
+def topk_split0():
+    a = ht.random.random((N,), split=0)
+    v, _ = ht.topk(a, 64)
+    return v.larray
+
+
+@monitor("percentile_split0")
+def percentile_split0():
+    a = ht.random.random((N,), split=0)
+    return ht.percentile(a, [25.0, 50.0, 99.0]).larray
+
+
+@monitor("median_split_axis")
+def median_split_axis():
+    a = ht.random.random((N // 128, 128), split=0)
+    return ht.median(a, axis=0).larray
+
+
+@monitor("unique_split0")
+def unique_split0():
+    a = (ht.random.random((N,), split=0) * 512.0).floor()
+    return ht.unique(a).larray

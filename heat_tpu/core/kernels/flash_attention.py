@@ -49,6 +49,42 @@ _BWD_BK = 512
 _MAX_PAIRS = 8192
 
 
+def _compiler_params(pltpu):
+    """Mosaic params shared by all three kernels: the batch·head grid dim is
+    embarrassingly parallel (no state crosses it), the pair dim is a sequential
+    sweep (softmax/accumulator state carries across it). Marking them lets the
+    compiler reorder/parallelise batch steps instead of assuming a serial grid.
+    ``HEAT_TPU_FLASH_VMEM_LIMIT`` (bytes) lifts the VMEM budget for block-size
+    experiments on real hardware."""
+    import os
+
+    vmem = os.environ.get("HEAT_TPU_FLASH_VMEM_LIMIT")
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary"),
+        vmem_limit_bytes=int(vmem) if vmem else None,
+    )
+
+
+def _env_blocks(default_bq: int, default_bk: int):
+    """Block-size override for on-chip tuning: HEAT_TPU_FLASH_BLOCKS=\"bq,bk\".
+
+    Read at TRACE time: jit caches by shape/dtype, so changing the env between
+    same-shape calls in one process reuses the first compilation — run each
+    config in a fresh process (or clear jax caches) when sweeping."""
+    import os
+
+    spec = os.environ.get("HEAT_TPU_FLASH_BLOCKS")
+    if not spec:
+        return default_bq, default_bk
+    try:
+        bq, bk = (int(x) for x in spec.split(","))
+    except ValueError:
+        return default_bq, default_bk
+    if bq <= 0 or bk <= 0:
+        return default_bq, default_bk
+    return bq, bk
+
+
 def _fwd_blocks(dtype, tq: int, tk: int, with_bias: bool = False) -> tuple:
     """Largest preferred (bq, bk) that tiles (tq, tk) evenly, else the smallest
     preference (whose divisibility _fits re-checks and may reject). A streamed
@@ -56,6 +92,9 @@ def _fwd_blocks(dtype, tq: int, tk: int, with_bias: bool = False) -> tuple:
     smaller f32 tile preferences."""
     size = 4 if with_bias else jnp.dtype(dtype).itemsize
     prefs = _FWD_BLOCK_PREFS.get(size, ((512, 512),))
+    ebq, ebk = _env_blocks(0, 0)
+    if ebq and tq % ebq == 0 and tk % ebk == 0:  # on-chip tuning override
+        return ebq, ebk
     for bq, bk in prefs:
         if tq % bq == 0 and tk % bk == 0:
             return bq, bk
@@ -243,6 +282,7 @@ def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
                 jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
             ],
             interpret=interpret,
+            compiler_params=None if interpret else _compiler_params(pltpu),
         )(jnp.asarray(im), jnp.asarray(jm), jnp.asarray(flags), *inputs)
         return out.reshape(*batch, tq, d), lse.reshape(*batch, tq)
 
@@ -453,6 +493,7 @@ def _flash_bwd_pallas(q, k, v, o, do, lse, causal: bool, scale: float, bq: int,
             grid_spec=dq_spec,
             out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
             interpret=interpret,
+            compiler_params=None if interpret else _compiler_params(pltpu),
         )(jnp.asarray(im), jnp.asarray(jm), jnp.asarray(flags), *dq_inputs)
 
         jm2, im2, flags2 = _pair_schedule_kv(tq // bq, tk // bk, bq, bk, causal)
@@ -491,6 +532,7 @@ def _flash_bwd_pallas(q, k, v, o, do, lse, causal: bool, scale: float, bq: int,
                 jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
             ],
             interpret=interpret,
+            compiler_params=None if interpret else _compiler_params(pltpu),
         )(jnp.asarray(jm2), jnp.asarray(im2), jnp.asarray(flags2), *dkv_inputs)
         return (
             dq.reshape(*batch, tq, d),
@@ -523,7 +565,12 @@ def _fits(q, k, bq: int, bk: int, with_bias: bool = False) -> bool:
     fwd = 8 * bq * bk + 4 * bq * d + 2 * (bq + 2 * bk) * d * itemsize * 2 + bias_fwd
     bwd = 8 * _BWD_BQ * _BWD_BK + 8 * _BWD_BK * d \
         + 2 * (_BWD_BQ + 2 * _BWD_BK) * d * itemsize * 2 + bias_bwd
-    return max(fwd, bwd) <= 12 * 2**20
+    import os
+
+    # the same knob _compiler_params forwards to Mosaic, so block-size
+    # experiments that lift the VMEM budget actually reach the flash path
+    limit = int(os.environ.get("HEAT_TPU_FLASH_VMEM_LIMIT") or 12 * 2**20)
+    return max(fwd, bwd) <= limit
 
 
 def _as_bias(mask):
