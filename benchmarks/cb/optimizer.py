@@ -13,12 +13,13 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
 
-import numpy as np
-
 import heat_tpu as ht
 from benchmarks.cb.monitor import monitor
 
 HIDDEN = int(os.environ.get("HEAT_TPU_BENCH_DASO_HIDDEN", "2048"))
+
+_printed = False  # the monitor calls the body twice (warmup + timed); the first,
+# cold materialize is the honest residency measure — print its metric only
 
 
 @monitor("daso_materialize_memory")
@@ -36,11 +37,14 @@ def daso_materialize_memory():
             total += a.size * a.dtype.itemsize
         return total
 
+    global _printed
     ndev = len(jax.devices())
     if ndev < 4 or ndev % 2:
         # an unflagged near-zero time would read as "probe ran, no regression"
-        print('{"metric": "daso_materialize_extra_param_copies", "value": null, '
-              '"skipped": "needs an even mesh of >= 4 devices, got %d"}' % ndev)
+        if not _printed:
+            _printed = True
+            print('{"metric": "daso_materialize_extra_param_copies", "value": null, '
+                  '"skipped": "needs an even mesh of >= 4 devices, got %d"}' % ndev)
         return jnp.zeros(())
     comm = ht.core.communication.MeshCommunication.hierarchical(2, jax.devices())
     model = ht.nn.Sequential(
@@ -60,9 +64,11 @@ def daso_materialize_memory():
     daso._materialize()
     after = live_bytes()
     extra = after - before
-    print(
-        '{"metric": "daso_materialize_extra_param_copies", "value": %.3f, '
-        '"unit": "x param bytes", "param_mb": %.1f}'
-        % (extra / max(param_bytes, 1), param_bytes / 1e6)
-    )
+    if not _printed:
+        _printed = True
+        print(
+            '{"metric": "daso_materialize_extra_param_copies", "value": %.3f, '
+            '"unit": "x param bytes", "param_mb": %.1f}'
+            % (extra / max(param_bytes, 1), param_bytes / 1e6)
+        )
     return jax.tree.leaves(daso.stacked_params)[0]

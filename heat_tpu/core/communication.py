@@ -427,6 +427,61 @@ class MeshCommunication(Communication):
 
     Exscan = exscan
 
+    def scan(self, x, axis_name: Optional[str] = None):
+        """Inclusive prefix-sum over shards (reference Scan ``communication.py:1881``):
+        the exclusive scan plus the local contribution."""
+        return self.exscan(x, axis_name) + x
+
+    Scan = scan
+
+    def reduce(self, x, root: int = 0, axis_name: Optional[str] = None):
+        """Sum-reduce with the result significant only at shard ``root`` (reference
+        Reduce ``communication.py:1823``): SPMD collectives are symmetric, so this
+        is the all-reduce with non-root shards zeroed — the rooted contract without
+        a second collective."""
+        name = axis_name or self.axis_name
+        total = jax.lax.psum(x, name)
+        idx = jax.lax.axis_index(name)
+        return jnp.where(idx == root, total, jnp.zeros_like(total))
+
+    Reduce = reduce
+
+    def gather(self, x, axis: int = 0, root: int = 0, axis_name: Optional[str] = None):
+        """Gather shards to ``root`` (reference Gather ``communication.py:1299``):
+        the all-gather with non-root shards zeroed — rooted semantics on a
+        symmetric collective."""
+        name = axis_name or self.axis_name
+        full = jax.lax.all_gather(x, name, axis=axis, tiled=True)
+        idx = jax.lax.axis_index(name)
+        return jnp.where(idx == root, full, jnp.zeros_like(full))
+
+    Gather = gather
+
+    def scatter(self, x, axis: int = 0, root: int = 0, axis_name: Optional[str] = None):
+        """Scatter ``root``'s value in equal chunks along ``axis`` (reference
+        Scatter ``communication.py:1936``). Binomial-tree broadcast of the full
+        payload followed by a local slice: XLA has no rooted scatter primitive, so
+        the wire cost is the broadcast's P−1 full payloads rather than MPI's 1/P
+        chunks — acceptable because every framework path that needs 1/P placement
+        uses shardings (``comm.shard``), not this rooted op."""
+        name = axis_name or self.axis_name
+        full = self.broadcast(x, root=root, axis_name=name)
+        idx = jax.lax.axis_index(name)
+        # the size of the NAMED axis (a sub-axis on hierarchical meshes), which is
+        # static at trace time — dynamic_slice needs a static chunk size
+        names = (name,) if isinstance(name, str) else tuple(name)
+        axsize = int(np.prod([self.mesh.shape[n] for n in names]))
+        if full.shape[axis] % axsize:
+            raise ValueError(
+                f"scatter: extent {full.shape[axis]} along axis {axis} is not "
+                f"divisible by the {axsize}-shard axis {name!r} (MPI_Scatter "
+                f"semantics require exact chunks)"
+            )
+        c = full.shape[axis] // axsize
+        return jax.lax.dynamic_slice_in_dim(full, idx * c, c, axis=axis)
+
+    Scatter = scatter
+
     # ------------------------------------------------------------------ misc parity
     def Split(self, color=0, key: int = 0) -> "MeshCommunication":
         """Sub-communicator by colour (reference MPI ``Comm.Split``, ``communication.py:465``).

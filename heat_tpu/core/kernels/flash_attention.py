@@ -49,6 +49,22 @@ _BWD_BK = 512
 _MAX_PAIRS = 8192
 
 
+def _env_vmem_limit():
+    """HEAT_TPU_FLASH_VMEM_LIMIT in bytes, or None when unset, malformed, or not
+    positive (graceful degradation, like _env_blocks — a bad value must not take
+    down every attention dispatch)."""
+    import os
+
+    raw = os.environ.get("HEAT_TPU_FLASH_VMEM_LIMIT")
+    if not raw:
+        return None
+    try:
+        v = int(raw.strip())
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
 def _compiler_params(pltpu):
     """Mosaic params shared by all three kernels: the batch·head grid dim is
     embarrassingly parallel (no state crosses it), the pair dim is a sequential
@@ -56,12 +72,9 @@ def _compiler_params(pltpu):
     compiler reorder/parallelise batch steps instead of assuming a serial grid.
     ``HEAT_TPU_FLASH_VMEM_LIMIT`` (bytes) lifts the VMEM budget for block-size
     experiments on real hardware."""
-    import os
-
-    vmem = os.environ.get("HEAT_TPU_FLASH_VMEM_LIMIT")
     return pltpu.CompilerParams(
         dimension_semantics=("parallel", "arbitrary"),
-        vmem_limit_bytes=int(vmem) if vmem else None,
+        vmem_limit_bytes=_env_vmem_limit(),
     )
 
 
@@ -565,11 +578,9 @@ def _fits(q, k, bq: int, bk: int, with_bias: bool = False) -> bool:
     fwd = 8 * bq * bk + 4 * bq * d + 2 * (bq + 2 * bk) * d * itemsize * 2 + bias_fwd
     bwd = 8 * _BWD_BQ * _BWD_BK + 8 * _BWD_BK * d \
         + 2 * (_BWD_BQ + 2 * _BWD_BK) * d * itemsize * 2 + bias_bwd
-    import os
-
     # the same knob _compiler_params forwards to Mosaic, so block-size
     # experiments that lift the VMEM budget actually reach the flash path
-    limit = int(os.environ.get("HEAT_TPU_FLASH_VMEM_LIMIT") or 12 * 2**20)
+    limit = _env_vmem_limit() or 12 * 2**20
     return max(fwd, bwd) <= limit
 
 

@@ -112,6 +112,47 @@ class TestCollectives:
         out = smap(lambda v: comm.exscan(v), P(AX), P(AX))(x)
         np.testing.assert_allclose(np.asarray(out), np.arange(comm.size))
 
+    def test_scan(self):
+        # inclusive prefix against the numpy cumsum oracle, non-uniform values
+        x = (jnp.arange(comm.size, dtype=jnp.float32) + 1.0) * 2.0
+        out = smap(lambda v: comm.scan(v), P(AX), P(AX))(x)
+        np.testing.assert_allclose(np.asarray(out), np.cumsum(np.asarray(x)))
+
+    def test_reduce_rooted(self):
+        x = jnp.arange(comm.size, dtype=jnp.float32) + 1.0
+        for root in (0, comm.size - 1):
+            out = smap(lambda v: comm.reduce(v, root=root), P(AX), P(AX))(x)
+            want = np.zeros(comm.size, np.float32)
+            want[root] = float(np.asarray(x).sum())
+            np.testing.assert_allclose(np.asarray(out), want)
+
+    def test_gather_rooted(self):
+        n = comm.size
+        x = jnp.arange(2 * n, dtype=jnp.float32)
+        root = n - 1
+        out = smap(
+            lambda v: comm.gather(v, axis=0, root=root)[None], P(AX), P(AX, None)
+        )(x)
+        for r in range(n):
+            want = np.asarray(x) if r == root else np.zeros(2 * n, np.float32)
+            np.testing.assert_allclose(np.asarray(out[r]), want)
+
+    def test_scatter(self):
+        n = comm.size
+        buf = jnp.arange(2 * n, dtype=jnp.float32)
+
+        # every shard offers a buffer; MPI semantics: only root's content matters
+        def block(v):
+            mine = jnp.where(jax.lax.axis_index(AX) == 1, v, -v)
+            return comm.scatter(mine, axis=0, root=1)
+
+        out = smap(block, P(), P(AX))(buf)  # shard r receives chunk r of root's buf
+        np.testing.assert_allclose(np.asarray(out), np.asarray(buf))
+
+    def test_mpi_rooted_aliases(self):
+        assert comm.Scan == comm.scan and comm.Reduce == comm.reduce
+        assert comm.Gather == comm.gather and comm.Scatter == comm.scatter
+
 
 class TestSplit:
     def test_scalar_color_dup(self):
@@ -256,6 +297,26 @@ class TestHierarchicalCollectives:
             np.asarray(slow), np.repeat(xn.sum(0, keepdims=True), n_nodes, 0)
         )
         np.testing.assert_allclose(np.asarray(both), np.full_like(xn, xn.sum()))
+
+    def test_scatter_sub_axis(self, hcomm):
+        """scatter over the ici sub-axis must chunk by THAT axis's size, not the
+        whole mesh size (regression: elements past size//mesh_size were dropped)."""
+        dcn, ici = hcomm.axis_names
+        n_nodes, node_size = hcomm.n_nodes, hcomm.node_size
+        buf = jnp.arange(2 * node_size, dtype=jnp.float32)
+
+        def body(v):
+            return hcomm.scatter(v, axis=0, root=0, axis_name=ici)
+
+        out = jax.shard_map(
+            body, mesh=hcomm.mesh, in_specs=P(), out_specs=P(ici)
+        )(buf)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(buf))
+        with pytest.raises(ValueError):
+            jax.shard_map(
+                lambda v: hcomm.scatter(v, axis=0, axis_name=ici),
+                mesh=hcomm.mesh, in_specs=P(), out_specs=P(ici),
+            )(jnp.arange(2 * node_size + 1, dtype=jnp.float32))
 
     def test_topology_properties(self, hcomm):
         assert hcomm.is_hierarchical
