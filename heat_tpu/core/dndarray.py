@@ -38,6 +38,14 @@ __all__ = ["DNDarray", "LocalIndex"]
 Scalar = Union[int, float, bool, complex]
 
 
+class _CallableTuple(tuple):
+    """A tuple that may also be called (torch's ``x.stride()`` spelling and
+    numpy's ``x.stride`` both work against the same property)."""
+
+    def __call__(self, dim: Optional[int] = None):
+        return self if dim is None else self[dim]
+
+
 class LocalIndex:
     """Marker for indexing the process-local data (reference ``dndarray.py:23``)."""
 
@@ -277,13 +285,15 @@ class DNDarray:
 
     @property
     def stride(self) -> Tuple[int, ...]:
-        """Row-major strides in elements (reference returns torch strides)."""
+        """Row-major strides in elements. The reference exposes the torch bound
+        method (usage ``x.stride()``, ``dndarray.py:330-335``); numpy users expect
+        a tuple. A callable tuple serves both spellings."""
         strides = []
         acc = 1
         for s in reversed(self.__gshape):
             strides.append(acc)
             acc *= max(s, 1)
-        return tuple(reversed(strides))
+        return _CallableTuple(reversed(strides))
 
     @property
     def strides(self) -> Tuple[int, ...]:

@@ -91,7 +91,7 @@ class TestSetitemCrossSplit:
         want = BASE.copy()
         want[2] = val
         a = ht.array(BASE, split=split)
-        a[2] = ht.array(val, split=vsplit if vsplit != 2 else None)
+        a[2] = ht.array(val, split=vsplit)
         np.testing.assert_allclose(a.numpy(), want, rtol=1e-6)
 
 

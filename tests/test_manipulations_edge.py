@@ -58,7 +58,7 @@ class TestReshapeFamily(EdgeBase):
         with self.assertRaises(ValueError):
             ht.squeeze(ht.array(a), axis=1)  # non-1 extent
 
-    def tests_broadcast_to(self):
+    def test_broadcast_to(self):
         a = np.arange(6, dtype=np.float32).reshape(3, 1, 2)
         for shape in ((3, 4, 2), (5, 3, 1, 2), (3, 1, 2)):
             self.sweep(a, lambda x, s=shape: (
@@ -131,7 +131,7 @@ class TestStacks(EdgeBase):
         c = a - 5
         return a, b, c
 
-    def stack_sweep(self, ht_fn, np_fn, shapes=None):
+    def stack_sweep(self, ht_fn, np_fn):
         a, b, c = self.arrays()
         want = np_fn([a, b, c])
         for split in (None, 0, 1):
