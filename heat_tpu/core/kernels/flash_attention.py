@@ -103,7 +103,9 @@ def _fwd_blocks(dtype, tq: int, tk: int, with_bias: bool = False) -> tuple:
     preference (whose divisibility _fits re-checks and may reject). A streamed
     bias adds a double-buffered f32 (bq, bk) block, so biased bf16 runs use the
     smaller f32 tile preferences."""
-    size = 4 if with_bias else jnp.dtype(dtype).itemsize
+    size = 4 if (with_bias or _pipeline_enabled()) else jnp.dtype(dtype).itemsize
+    # (the pipelined kernel keeps an extra f32 (bq, bk) score buffer resident, so
+    # it takes the smaller-tile preference table like biased runs do)
     prefs = _FWD_BLOCK_PREFS.get(size, ((512, 512),))
     ebq, ebk = _env_blocks(0, 0)
     if ebq and tq % ebq == 0 and tk % ebk == 0:  # on-chip tuning override
@@ -129,6 +131,27 @@ def flash_attention_reference(q, k, v, causal: bool = False, scale=None):
     l = jnp.sum(p, axis=-1, keepdims=True)
     o = jnp.einsum("...qk,...kd->...qd", p, v, preferred_element_type=jnp.float32)
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def _online_softmax_update(s, vb, acc_ref, m_ref, l_ref, has_bias: bool):
+    """One tile of the online-softmax recurrence, shared by the plain and
+    pipelined forward kernels (a numerical change here reaches both)."""
+    m = m_ref[...]
+    m_blk = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m, m_blk)
+    # a bias can mask a whole row of the block (all -inf): keep the exps finite —
+    # the row's l stays 0 and its output finalizes to 0 like the dense path
+    m_safe = jnp.maximum(m_new, _NEG_INF / 2) if has_bias else m_new
+    p_tile = jnp.exp(s - m_safe)
+    corr = jnp.exp(m - m_safe)
+    l_ref[...] = l_ref[...] * corr + jnp.sum(p_tile, axis=1, keepdims=True)
+    # probabilities ride the MXU in the value dtype (standard flash practice;
+    # p ∈ [0,1] so the bf16 round-off is bounded), accumulation stays f32
+    acc_ref[...] = acc_ref[...] * corr + lax.dot_general(
+        p_tile.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[...] = m_new
 
 
 def _kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, *refs,
@@ -177,23 +200,7 @@ def _kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, *refs,
         s = s + bias_ref[...]
 
     def _update(s):
-        m = m_ref[...]
-        m_blk = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m, m_blk)
-        # a bias can mask a whole row of the block (all -inf): keep the exps
-        # finite — the row's l stays 0 and its output finalizes to 0 like the
-        # dense path
-        m_safe = jnp.maximum(m_new, _NEG_INF / 2) if has_bias else m_new
-        p_tile = jnp.exp(s - m_safe)
-        corr = jnp.exp(m - m_safe)
-        l_ref[...] = l_ref[...] * corr + jnp.sum(p_tile, axis=1, keepdims=True)
-        # probabilities ride the MXU in the value dtype (standard flash practice;
-        # p ∈ [0,1] so the bf16 round-off is bounded), accumulation stays f32
-        acc_ref[...] = acc_ref[...] * corr + lax.dot_general(
-            p_tile.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[...] = m_new
+        _online_softmax_update(s, vb, acc_ref, m_ref, l_ref, has_bias)
 
     # only diagonal-straddling blocks pay the iota/where mask; fully-below
     # blocks take the plain branch — pl.when predication, not a lane-wise select,
@@ -215,6 +222,108 @@ def _kernel(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, *refs,
         # log-sum-exp residual for the backward pass: L = m + log(l); the clamp
         # keeps fully-masked rows finite so the backward's exp(s - L) is 0, not NaN
         lse_ref[0] = jnp.maximum(m_ref[...], _NEG_INF / 2) + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _pipeline_enabled() -> bool:
+    """HEAT_TPU_FLASH_PIPELINE=1 selects the one-step-skewed forward kernel: each
+    grid step computes QK for pair p while running exp/PV for pair p−1 — the two
+    chains share no data, so Mosaic's scheduler can issue the VPU exp pass
+    concurrently with the MXU matmuls instead of serialising them (the overlap
+    the ceiling analysis in doc/source/flash_attention_perf.rst identifies as the
+    gap between the ~33 and ~49 TFLOP/s bounds). Off by default until measured
+    on hardware; read at trace time (same caveat as _env_blocks)."""
+    import os
+
+    return os.environ.get("HEAT_TPU_FLASH_PIPELINE") == "1"
+
+
+def _kernel_pipelined(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, *refs,
+                      scale: float, bq: int, bk: int, has_bias: bool = False):
+    """One-step software pipeline over the flattened pair grid.
+
+    Step p holds TWO independent chains: (a) exp + rescale + PV for the score
+    tile the previous step left in ``s_ref`` (consumes the LAGGED v block the
+    index map streams), and (b) the QK matmul for pair p, written to ``s_ref``
+    afterwards. A flush step (flag bit 8) per q-row consumes the row's final
+    tile and finalizes — it has no QK phase, so every step needs only one v
+    block. ``s_prev`` is loaded before (b) overwrites the buffer."""
+    import jax.experimental.pallas as pl
+
+    if has_bias:
+        bias_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, s_ref = refs
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref, s_ref = refs
+
+    p = pl.program_id(1)
+    flags = flags_ref[p]
+    is_first, is_last, is_flush = flags & 1, flags & 2, flags & 8
+    p_prev = jnp.maximum(p - 1, 0)
+    prev_mask = flags_ref[p_prev] & 4
+
+    @pl.when(is_first != 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    s_prev = s_ref[...]  # loaded before this step's QK overwrites the buffer
+    vb = v_ref[0]  # v block of the PREVIOUS pair (lagged index map)
+
+    def _update(s):
+        _online_softmax_update(s, vb, acc_ref, m_ref, l_ref, has_bias)
+
+    @pl.when((is_first == 0) & (prev_mask != 0))
+    def _prev_masked():
+        rows = im_ref[p_prev] * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = jm_ref[p_prev] * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        _update(jnp.where(rows >= cols, s_prev, _NEG_INF))
+
+    @pl.when((is_first == 0) & (prev_mask == 0))
+    def _prev_plain():
+        _update(s_prev)
+
+    @pl.when(is_flush == 0)
+    def _qk():
+        s = (
+            lax.dot_general(
+                q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        if has_bias:
+            s = s + bias_ref[...]
+        s_ref[...] = s
+
+    @pl.when(is_last != 0)
+    def _finalize():
+        l = l_ref[...]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = jnp.maximum(m_ref[...], _NEG_INF / 2) + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _pair_schedule_pipelined(nq: int, nk: int, bq: int, bk: int, causal: bool):
+    """Derived from :func:`_pair_schedule` (single-sourced pair set): finalize
+    (bit 2) moves off the real pairs onto one flush step (bits 2|8) appended per
+    q-row. The flush's (i, j) repeats the row's last pair so the k/v index maps
+    stay in range."""
+    import numpy as np
+
+    im, jm, flags = _pair_schedule(nq, nk, bq, bk, causal)
+    out_im, out_jm, out_fl = [], [], []
+    for i, j, f in zip(im.tolist(), jm.tolist(), flags.tolist()):
+        out_im.append(i)
+        out_jm.append(j)
+        out_fl.append(f & ~2)
+        if f & 2:  # last pair of the row: append its flush step
+            out_im.append(i)
+            out_jm.append(j)
+            out_fl.append(2 | 8)
+    return (
+        np.asarray(out_im, np.int32),
+        np.asarray(out_jm, np.int32),
+        np.asarray(out_fl, np.int32),
+    )
 
 
 def _pair_schedule(nq: int, nk: int, bq: int, bk: int, causal: bool):
@@ -241,10 +350,10 @@ def _pair_schedule(nq: int, nk: int, bq: int, bk: int, causal: bool):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "bq", "bk", "interpret")
+    jax.jit, static_argnames=("causal", "scale", "bq", "bk", "interpret", "pipelined")
 )
 def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
-                  interpret: bool = False, bias=None):
+                  interpret: bool = False, bias=None, pipelined: bool = False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -257,13 +366,21 @@ def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
         vr = v.reshape(bh, tk, d)
         has_bias = bias is not None
 
-        im, jm, flags = _pair_schedule(tq // bq, tk // bk, bq, bk, causal)
+        schedule = _pair_schedule_pipelined if pipelined else _pair_schedule
+        im, jm, flags = schedule(tq // bq, tk // bk, bq, bk, causal)
         npairs = len(im)
 
+        if pipelined:
+            # the exp/PV chain consumes the PREVIOUS pair's v block
+            v_spec = pl.BlockSpec(
+                (1, bk, d), lambda b, p, im, jm, fl: (b, jm[jnp.maximum(p - 1, 0)], 0)
+            )
+        else:
+            v_spec = pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0))
         in_specs = [
             pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
             pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
-            pl.BlockSpec((1, bk, d), lambda b, p, im, jm, fl: (b, jm[p], 0)),
+            v_spec,
         ]
         inputs = [qr, kr, vr]
         if has_bias:
@@ -273,6 +390,13 @@ def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
                 pl.BlockSpec((bq, bk), lambda b, p, im, jm, fl: (im[p], jm[p]))
             )
             inputs.append(bias.astype(jnp.float32))
+        scratch_shapes = [
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ]
+        if pipelined:
+            scratch_shapes.append(pltpu.VMEM((bq, bk), jnp.float32))  # skewed scores
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(bh, npairs),
@@ -281,14 +405,11 @@ def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
                 pl.BlockSpec((1, bq, d), lambda b, p, im, jm, fl: (b, im[p], 0)),
                 pl.BlockSpec((1, bq, 1), lambda b, p, im, jm, fl: (b, im[p], 0)),
             ],
-            scratch_shapes=[
-                pltpu.VMEM((bq, d), jnp.float32),
-                pltpu.VMEM((bq, 1), jnp.float32),
-                pltpu.VMEM((bq, 1), jnp.float32),
-            ],
+            scratch_shapes=scratch_shapes,
         )
+        kern = _kernel_pipelined if pipelined else _kernel
         out, lse = pl.pallas_call(
-            functools.partial(_kernel, scale=scale, bq=bq, bk=bk, has_bias=has_bias),
+            functools.partial(kern, scale=scale, bq=bq, bk=bk, has_bias=has_bias),
             grid_spec=grid_spec,
             out_shape=[
                 jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
@@ -565,8 +686,12 @@ def _fits(q, k, bq: int, bk: int, with_bias: bool = False) -> bool:
     if tq % _BWD_BQ or tk % _BWD_BK:
         return False
     # the flattened pair schedules are O((T/b)²) int32 scalar-prefetch entries
-    # living in SMEM — bound them (bwd uses the fixed _BWD blocks, check both)
-    if (tq // bq) * (tk // bk) > _MAX_PAIRS:
+    # living in SMEM — bound them (bwd uses the fixed _BWD blocks, check both);
+    # the pipelined schedule appends one flush step per q-row
+    fwd_steps = (tq // bq) * (tk // bk)
+    if _pipeline_enabled():
+        fwd_steps += tq // bq
+    if fwd_steps > _MAX_PAIRS:
         return False
     if (tq // _BWD_BQ) * (tk // _BWD_BK) > _MAX_PAIRS:
         return False
@@ -574,6 +699,8 @@ def _fits(q, k, bq: int, bk: int, with_bias: bool = False) -> bool:
     # per-step residency: s + p tiles (f32), accumulator, double-buffered blocks,
     # plus a double-buffered f32 bias block when a mask streams through
     bias_fwd = 8 * bq * bk if with_bias else 0
+    if _pipeline_enabled():
+        bias_fwd += 4 * bq * bk  # the skewed score buffer stays resident
     bias_bwd = 8 * _BWD_BQ * _BWD_BK if with_bias else 0
     fwd = 8 * bq * bk + 4 * bq * d + 2 * (bq + 2 * bk) * d * itemsize * 2 + bias_fwd
     bwd = 8 * _BWD_BQ * _BWD_BK + 8 * _BWD_BK * d \
@@ -612,7 +739,8 @@ def flash_attention(q, k, v, causal: bool = False, scale=None, mask=None):
     s = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
     bias = _as_bias(mask)
     blocks = _fwd_blocks(q.dtype, q.shape[-2], k.shape[-2], with_bias=bias is not None)
-    out, _ = _flash_pallas(q, k, v, causal, float(s), *blocks, bias=bias)
+    out, _ = _flash_pallas(q, k, v, causal, float(s), *blocks, bias=bias,
+                            pipelined=_pipeline_enabled())
     return out
 
 
@@ -620,7 +748,8 @@ def _fwd(q, k, v, causal, scale, mask):
     s = (1.0 / math.sqrt(q.shape[-1])) if scale is None else scale
     bias = _as_bias(mask)
     blocks = _fwd_blocks(q.dtype, q.shape[-2], k.shape[-2], with_bias=bias is not None)
-    out, lse = _flash_pallas(q, k, v, causal, float(s), *blocks, bias=bias)
+    out, lse = _flash_pallas(q, k, v, causal, float(s), *blocks, bias=bias,
+                              pipelined=_pipeline_enabled())
     return out, (q, k, v, out, lse, mask)
 
 

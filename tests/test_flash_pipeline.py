@@ -1,0 +1,81 @@
+"""Parity tests for the one-step-skewed (software-pipelined) flash forward
+(HEAT_TPU_FLASH_PIPELINE=1): every step overlaps pair p's QK with pair p-1's
+exp/PV — see doc/source/flash_attention_perf.rst. The flag is read at trace
+time, so these tests pass `pipelined=True` explicitly instead of mutating env."""
+
+import unittest
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from heat_tpu.core.kernels import flash_attention as fa
+
+
+class TestPipelinedFlashParity(unittest.TestCase):
+    def run_case(self, b, h, tq, tk, d, causal, dtype, bq=128, bk=128):
+        rng = np.random.default_rng(hash((b, h, tq, tk, d, causal)) % 2**32)
+        q = jnp.asarray(rng.standard_normal((b, h, tq, d)), dtype)
+        k = jnp.asarray(rng.standard_normal((b, h, tk, d)), dtype)
+        v = jnp.asarray(rng.standard_normal((b, h, tk, d)), dtype)
+        scale = float(1.0 / np.sqrt(d))
+        out, lse = fa._flash_pallas(q, k, v, causal, scale, bq, bk,
+                                    interpret=True, pipelined=True)
+        want = fa.flash_attention_reference(q, k, v, causal=causal)
+        tol = 2e-2 if dtype == jnp.bfloat16 else 2e-5
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(want, np.float32),
+            rtol=tol, atol=tol,
+        )
+        # the pipelined and plain kernels must agree bit-for-bit on the LSE
+        # residual the backward consumes
+        _, lse0 = fa._flash_pallas(q, k, v, causal, scale, bq, bk,
+                                   interpret=True, pipelined=False)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(lse0),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_causal_square(self):
+        self.run_case(1, 2, 512, 512, 64, True, jnp.float32)
+
+    def test_noncausal_square(self):
+        self.run_case(1, 2, 512, 512, 64, False, jnp.float32)
+
+    def test_cross_length_bf16(self):
+        self.run_case(2, 1, 256, 512, 32, True, jnp.bfloat16)
+
+    def test_single_pair_rows(self):
+        # bq == tq: each row is one pair + one flush — the smallest schedule
+        self.run_case(1, 1, 128, 256, 32, True, jnp.float32)
+
+    def test_bias_stream(self):
+        rng = np.random.default_rng(5)
+        t, d = 512, 64
+        q = jnp.asarray(rng.standard_normal((1, 2, t, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 2, t, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 2, t, d)), jnp.float32)
+        bias = jnp.where(
+            jnp.asarray(rng.random((t, t)) > 0.2), 0.0, -1e30
+        ).astype(jnp.float32)
+        out, _ = fa._flash_pallas(q, k, v, False, 0.125, 128, 128,
+                                  interpret=True, bias=bias, pipelined=True)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.125 + bias
+        want = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_schedule_invariants(self):
+        for nq, nk, causal in [(4, 4, True), (4, 4, False), (2, 6, True), (1, 1, True)]:
+            im, jm, fl = fa._pair_schedule_pipelined(nq, nk, 128, 128, causal)
+            base_im, base_jm, _ = fa._pair_schedule(nq, nk, 128, 128, causal)
+            # one flush per row, each carrying finalize; QK steps match the base
+            self.assertEqual(len(im), len(base_im) + nq)
+            flush = fl & 8 != 0
+            self.assertEqual(int(flush.sum()), nq)
+            self.assertTrue(((fl & 2 != 0) == flush).all())  # finalize only on flush
+            np.testing.assert_array_equal(im[~flush], base_im)
+            np.testing.assert_array_equal(jm[~flush], base_jm)
+
+
+if __name__ == "__main__":
+    unittest.main()
