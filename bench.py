@@ -164,7 +164,31 @@ def _bench_attention(ht, jax, jnp, on_tpu):
     pad_mask = jnp.broadcast_to(jnp.arange(t)[None, :] < (t - t // 8), (t, t))
     best_m = best_of_3(jax.jit(lambda q, k, v: sdpa(q, k, v, attn_mask=pad_mask)))
     masked_flops = 2 * 2 * b * h * t * (t - t // 8) * d
-    return b, h, t, d, flops / best / 1e12, masked_flops / best_m / 1e12
+
+    # A/B the skewed software pipeline (doc/source/flash_attention_perf.rst): the
+    # flag is read at trace time, so a FRESH jitted wrapper built after setting it
+    # compiles the pipelined kernel; scarce healthy-relay windows capture both.
+    import os
+
+    best_p = None
+    if on_tpu and os.environ.get("HEAT_TPU_FLASH_PIPELINE") != "1":
+        # skip the A/B when the operator already forced the pipeline on — the
+        # baseline above would have traced pipelined too (A/A, not A/B)
+        prior = os.environ.get("HEAT_TPU_FLASH_PIPELINE")
+        os.environ["HEAT_TPU_FLASH_PIPELINE"] = "1"
+        try:
+            best_p = best_of_3(jax.jit(lambda q, k, v: sdpa(q, k, v, is_causal=True)))
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        finally:
+            if prior is None:
+                os.environ.pop("HEAT_TPU_FLASH_PIPELINE", None)
+            else:
+                os.environ["HEAT_TPU_FLASH_PIPELINE"] = prior
+    pipe_tflops = flops / best_p / 1e12 if best_p else None
+    return b, h, t, d, flops / best / 1e12, masked_flops / best_m / 1e12, pipe_tflops
 
 
 def _bench_sort(ht, jax, jnp, on_tpu):
@@ -315,11 +339,13 @@ def main():
     guarded(_bench_sort, lambda sn, s: {
         "metric": f"sort_{sn}_f32_split0",
         "value": round(sn / s / 1e6, 3), "unit": "Melem/s"})
-    guarded(_bench_attention, lambda ab, ah, at, ad, causal, masked: [
+    guarded(_bench_attention, lambda ab, ah, at, ad, causal, masked, piped: [
         {"metric": f"attention_causal_b{ab}h{ah}t{at}d{ad}_tflops",
          "value": round(causal, 3), "unit": "TFLOP/s"},
         {"metric": f"attention_padmask_b{ab}h{ah}t{at}d{ad}_tflops",
-         "value": round(masked, 3), "unit": "TFLOP/s"}])
+         "value": round(masked, 3), "unit": "TFLOP/s"}] + ([
+        {"metric": f"attention_causal_pipelined_b{ab}h{ah}t{at}d{ad}_tflops",
+         "value": round(piped, 3), "unit": "TFLOP/s"}] if piped else []))
 
     # vs_baseline = fraction of the chip's bf16 matmul peak; CPU: no target
     peak = _peak_tflops(jax) if on_tpu else max(tflops, 1e-9)

@@ -393,16 +393,36 @@ class DNDarray:
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
-        new = self.__comm.shard(self._logical(), axis)
+        self.__array = self._reshard(axis)
         self.__split = axis
-        self.__array = new
         self.__balanced = True
         return self
+
+    def _reshard(self, axis: Optional[int]) -> jax.Array:
+        """The physical value laid out for ``axis``. A ragged source resplits
+        padded-value-first: the all-to-all moves O(n/P) buffers and the old
+        padding is trimmed afterwards on the now-unsharded dim (a shard-local
+        slice) — the logical (replicated) trim never materialises. ``axis=None``
+        replicates by definition, so it takes the plain path; the unpadded path
+        is one re-sharding as before."""
+        if self._is_padded() and axis is not None and axis != self.__split:
+            moved = self.__comm.shard(self.__array, axis)
+            sl = tuple(
+                slice(0, s) if d == self.__split else slice(None)
+                for d, s in enumerate(self.__gshape)
+            )
+            return self.__comm.shard(moved[sl], axis)
+        return self.__comm.shard(self._logical(), axis)
 
     def resplit(self, axis: Optional[int] = None) -> "DNDarray":
         """Out-of-place resplit (reference ``manipulations.py:3480``)."""
         axis = sanitize_axis(self.__gshape, axis)
-        new = self.__comm.shard(self._logical(), axis)
+        if axis == self.__split:
+            return DNDarray(
+                self.__array, self.__gshape, self.__dtype, axis, self.__device,
+                self.__comm, True,
+            )
+        new = self._reshard(axis)
         return DNDarray(new, self.__gshape, self.__dtype, axis, self.__device, self.__comm, True)
 
     def collect_(self, target_rank: int = 0) -> "DNDarray":

@@ -234,6 +234,32 @@ class TestPaddedCompute(TestCase):
             z = self.assert_no_logical(lambda: ht.cumsum(x2, ax))
             np.testing.assert_allclose(z.numpy(), np.cumsum(a2, axis=ax), rtol=1e-5)
 
+    def test_resplit_ragged_stays_physical(self):
+        """resplit of a ragged array must move the padded value (O(n/P) all-to-all)
+        and never materialise the replicated logical trim."""
+        P = self.comm.size
+        if P == 1:
+            self.skipTest("needs a distributed mesh")
+        n = 3 * P + 1
+        a = np.random.default_rng(9).standard_normal((n, 2 * P)).astype(np.float32)
+        x = ht.array(a, split=0)
+        y = self.assert_no_logical(lambda: x.resplit(1))
+        self.assertEqual(y.split, 1)
+        np.testing.assert_allclose(y.numpy(), a, rtol=1e-6)
+        for s in y.parray.addressable_shards:
+            self.assertEqual(s.data.shape, (n, 2))  # dim-0 padding trimmed, 1/P on dim 1
+        # ragged -> ragged on the other dim
+        b = np.random.default_rng(10).standard_normal((n, n)).astype(np.float32)
+        z = ht.array(b, split=0)
+        w = self.assert_no_logical(lambda: z.resplit(1))
+        self.assertTrue(w._is_padded())
+        np.testing.assert_allclose(w.numpy(), b, rtol=1e-6)
+        # in-place form
+        z2 = ht.array(b, split=1)
+        self.assert_no_logical(lambda: z2.resplit_(0))
+        self.assertEqual(z2.split, 0)
+        np.testing.assert_allclose(z2.numpy(), b, rtol=1e-6)
+
     def test_copy_keeps_padded_layout(self):
         _, _, xa, _ = self.ragged_pair()
         y = self.assert_no_logical(lambda: ht.copy(xa))
