@@ -1,0 +1,145 @@
+"""Statistics edge matrix (VERDICT r4 #7): the reference test names missing from
+tests/test_statistics.py (`/root/reference/heat/core/tests/test_statistics.py`,
+1,432 LoC), driven across splits — including ragged extents, which now ride the
+padded-physical reduce paths — against numpy/scipy oracles."""
+
+import unittest
+
+import numpy as np
+import scipy.stats
+import torch
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase as _BaseTestCase
+
+
+class TestCase(_BaseTestCase):
+    """Suite base (comm + per-shard-aware asserts) plus the local data helper."""
+
+    def data(self, shape=(5, 13), seed=0):
+        return np.random.default_rng(seed).standard_normal(shape).astype(np.float32)
+
+
+class TestMinMaxFamily(TestCase):
+    def test_max(self):
+        a = self.data()
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            for axis in (None, 0, 1, (0, 1)):
+                for keepdims in (False, True):
+                    np.testing.assert_allclose(
+                        ht.max(x, axis=axis, keepdims=keepdims).numpy(),
+                        np.max(a, axis=axis, keepdims=keepdims),
+                        err_msg=f"split={split} axis={axis} keepdims={keepdims}",
+                    )
+        out = ht.zeros(5, dtype=ht.float32)
+        ht.max(ht.array(a, split=1), axis=1, out=out)
+        np.testing.assert_allclose(out.numpy(), a.max(axis=1))
+
+    def test_min(self):
+        a = self.data(seed=1)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            for axis in (None, 0, 1):
+                np.testing.assert_allclose(
+                    ht.min(x, axis=axis).numpy(), np.min(a, axis=axis)
+                )
+
+    def test_maximum(self):
+        a, b = self.data(seed=2), self.data(seed=3)
+        for split in (None, 0, 1):
+            z = ht.maximum(ht.array(a, split=split), ht.array(b, split=split))
+            np.testing.assert_allclose(z.numpy(), np.maximum(a, b))
+        # NaN propagates elementwise; broadcasting row
+        an = a.copy()
+        an[0, 0] = np.nan
+        z = ht.maximum(ht.array(an, split=0), ht.array(b[0]))
+        np.testing.assert_allclose(z.numpy(), np.maximum(an, b[0]))
+
+    def test_minimum(self):
+        a, b = self.data(seed=4), self.data(seed=5)
+        z = ht.minimum(ht.array(a, split=1), 0.25)
+        np.testing.assert_allclose(z.numpy(), np.minimum(a, 0.25))
+        z = ht.minimum(ht.array(a, split=0), ht.array(b, split=0))
+        np.testing.assert_allclose(z.numpy(), np.minimum(a, b))
+
+
+class TestMoments(TestCase):
+    def test_std(self):
+        P = self.comm.size
+        a = self.data((3, 4 * P + 1), seed=6)  # ragged second dim
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            for axis in (None, 0, 1):
+                for ddof in (0, 1):
+                    np.testing.assert_allclose(
+                        ht.std(x, axis=axis, ddof=ddof).numpy(),
+                        a.std(axis=axis, ddof=ddof),
+                        rtol=2e-4,
+                        err_msg=f"split={split} axis={axis} ddof={ddof}",
+                    )
+
+    def test_var(self):
+        P = self.comm.size
+        a = self.data((4 * P + 3,), seed=7)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            for ddof in (0, 1):
+                np.testing.assert_allclose(
+                    ht.var(x, ddof=ddof).numpy(), a.var(ddof=ddof), rtol=2e-4
+                )
+
+    def test_skew(self):
+        a = self.data((64,), seed=8)
+        for split in (None, 0):
+            got = float(ht.skew(ht.array(a, split=split)).numpy())
+            want = float(scipy.stats.skew(a, bias=False))
+            np.testing.assert_allclose(got, want, rtol=1e-3)
+        m = self.data((6, 32), seed=9)
+        got = ht.skew(ht.array(m, split=1), axis=1, unbiased=False).numpy()
+        want = scipy.stats.skew(m, axis=1, bias=True)
+        np.testing.assert_allclose(got, want, rtol=1e-3)
+
+    def test_kurtosis(self):
+        a = self.data((64,), seed=10)
+        for split in (None, 0):
+            got = float(ht.kurtosis(ht.array(a, split=split)).numpy())
+            want = float(scipy.stats.kurtosis(a, bias=False))
+            np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+        m = self.data((6, 32), seed=11)
+        got = ht.kurtosis(ht.array(m, split=0), axis=0, unbiased=False).numpy()
+        want = scipy.stats.kurtosis(m, axis=0, bias=True)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+class TestBinning(TestCase):
+    def test_bucketize(self):
+        boundaries = np.array([0.1, 0.5, 1.2, 3.0], np.float32)
+        v = np.array([-1.0, 0.1, 0.4, 0.5, 2.9, 3.0, 4.0], np.float32)
+        for split in (None, 0):
+            for right in (False, True):
+                got = ht.bucketize(ht.array(v, split=split), ht.array(boundaries), right=right)
+                want = torch.bucketize(torch.tensor(v), torch.tensor(boundaries), right=right)
+                np.testing.assert_array_equal(got.numpy(), want.numpy(),
+                                              err_msg=f"right={right}")
+
+    def test_digitize(self):
+        bins = np.array([0.0, 1.0, 2.5, 4.0], np.float32)
+        v = np.array([-0.5, 0.0, 0.9, 1.0, 2.5, 3.9, 4.0, 5.0], np.float32)
+        for split in (None, 0):
+            for right in (False, True):
+                got = ht.digitize(ht.array(v, split=split), ht.array(bins), right=right)
+                want = np.digitize(v, bins, right=right)
+                np.testing.assert_array_equal(got.numpy(), want,
+                                              err_msg=f"right={right}")
+
+    def test_histc(self):
+        v = self.data((257,), seed=12) * 3
+        for split in (None, 0):
+            got = ht.histc(ht.array(v, split=split), bins=16, min=-3.0, max=3.0)
+            want = torch.histc(torch.tensor(v), bins=16, min=-3.0, max=3.0)
+            np.testing.assert_allclose(got.numpy(), want.numpy())
+
+
+if __name__ == "__main__":
+    unittest.main()
