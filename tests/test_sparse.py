@@ -42,6 +42,26 @@ class TestSparse(TestCase):
         self.assertEqual(len(np.asarray(s.ldata)), lptr[-1])
         self.assertEqual(s.lshape[1], dense.shape[1])
 
+    def test_dcsr_attribute_surface(self):
+        """Reference test_dcsrmatrix.py attribute names (data/indices/indptr/nnz/
+        shape/dtype/larray/astype) across splits."""
+        dense = _sample(7)
+        for split in (None, 0):
+            s = ht.sparse.sparse_csr_matrix(ht.array(dense, split=split))
+            self.assertEqual(s.shape, dense.shape)
+            self.assertEqual(int(s.nnz), int(np.count_nonzero(dense)))
+            self.assertEqual(int(s.gnnz), int(s.nnz))
+            self.assertIs(s.dtype, ht.float32)
+            self.assertEqual(len(np.asarray(s.indptr)), dense.shape[0] + 1)
+            self.assertEqual(len(np.asarray(s.indices)), int(s.nnz))
+            self.assertEqual(len(np.asarray(s.data)), int(s.nnz))
+            self.assertIsNotNone(s.larray)
+            d = s.astype(ht.float64)
+            self.assertIs(d.dtype, ht.float64)
+            np.testing.assert_allclose(
+                np.asarray(d.todense().numpy()), dense, rtol=1e-6
+            )
+
     def test_add_mul_sparse(self):
         a, b = _sample(2), _sample(3)
         sa = ht.sparse.sparse_csr_matrix(ht.array(a), split=0)
