@@ -1,0 +1,167 @@
+"""Linalg basics edge matrix (VERDICT r4 #7 continuation): reference test names
+from `/root/reference/heat/core/linalg/tests/test_basics.py` driven across splits
+against the numpy oracle — norms (orders × axes), products (dot/vdot/vecdot/
+outer/cross), structure ops (tril/triu/trace/transpose), det/inv/projection."""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.testing import TestCase as _Base
+
+
+class TestCase(_Base):
+    def data(self, shape, seed=0):
+        return np.random.default_rng(seed).standard_normal(shape).astype(np.float32)
+
+
+class TestProducts(TestCase):
+    def test_dot(self):
+        a, b = self.data(16, 1), self.data(16, 2)
+        for s1 in (None, 0):
+            for s2 in (None, 0):
+                got = ht.dot(ht.array(a, split=s1), ht.array(b, split=s2))
+                np.testing.assert_allclose(float(got.numpy()), np.dot(a, b), rtol=1e-5)
+        m, v = self.data((4, 6), 3), self.data(6, 4)
+        got = ht.dot(ht.array(m, split=0), ht.array(v, split=0))
+        np.testing.assert_allclose(got.numpy(), m @ v, rtol=1e-5)
+        m2 = self.data((6, 3), 5)
+        got = ht.dot(ht.array(m, split=1), ht.array(m2, split=0))
+        np.testing.assert_allclose(got.numpy(), m @ m2, rtol=1e-5)
+
+    def test_matmul(self):
+        a, b = self.data((5, 7), 6), self.data((7, 4), 7)
+        for s1 in (None, 0, 1):
+            for s2 in (None, 0, 1):
+                got = ht.matmul(ht.array(a, split=s1), ht.array(b, split=s2))
+                np.testing.assert_allclose(got.numpy(), a @ b, rtol=1e-4,
+                                           err_msg=f"splits {s1}x{s2}")
+
+    def test_vdot(self):
+        a, b = self.data(24, 8), self.data(24, 9)
+        for split in (None, 0):
+            got = ht.vdot(ht.array(a, split=split), ht.array(b, split=split))
+            np.testing.assert_allclose(float(got.numpy()), np.vdot(a, b), rtol=1e-5)
+
+    def test_vecdot(self):
+        a, b = self.data((5, 8), 10), self.data((5, 8), 11)
+        for split in (None, 0, 1):
+            got = ht.vecdot(ht.array(a, split=split), ht.array(b, split=split))
+            np.testing.assert_allclose(got.numpy(), np.vecdot(a, b), rtol=1e-5)
+
+    def test_outer(self):
+        a, b = self.data(6, 12), self.data(9, 13)
+        for s1 in (None, 0):
+            for s2 in (None, 0):
+                got = ht.outer(ht.array(a, split=s1), ht.array(b, split=s2))
+                np.testing.assert_allclose(got.numpy(), np.outer(a, b), rtol=1e-5)
+
+    def test_cross(self):
+        a, b = self.data((7, 3), 14), self.data((7, 3), 15)
+        for split in (None, 0, 1):
+            got = ht.cross(ht.array(a, split=split), ht.array(b, split=split))
+            np.testing.assert_allclose(got.numpy(), np.cross(a, b), rtol=1e-5)
+
+
+class TestNorms(TestCase):
+    def test_norm(self):
+        v = self.data(17, 20)
+        m = self.data((5, 9), 21)
+        for split in (None, 0):
+            for order in (None, 1, 2, np.inf, -np.inf):
+                got = ht.norm(ht.array(v, split=split), ord=order)
+                np.testing.assert_allclose(
+                    float(got.numpy()), np.linalg.norm(v, ord=order), rtol=1e-5,
+                    err_msg=f"vector ord={order}",
+                )
+        for split in (None, 0, 1):
+            for order in (None, "fro", 1, np.inf):
+                got = ht.norm(ht.array(m, split=split), ord=order)
+                np.testing.assert_allclose(
+                    float(got.numpy()), np.linalg.norm(m, ord=order), rtol=1e-5,
+                    err_msg=f"matrix ord={order}",
+                )
+
+    def test_vector_norm(self):
+        m = self.data((5, 9), 22)
+        for split in (None, 0, 1):
+            for axis in (0, 1):
+                for order in (1, 2, np.inf):
+                    got = ht.vector_norm(ht.array(m, split=split), axis=axis, ord=order)
+                    np.testing.assert_allclose(
+                        got.numpy(),
+                        np.linalg.vector_norm(m, axis=axis, ord=order),
+                        rtol=1e-5,
+                    )
+
+    def test_matrix_norm(self):
+        m = self.data((6, 8), 23)
+        for split in (None, 0, 1):
+            for order in ("fro", 1, np.inf):
+                got = ht.matrix_norm(ht.array(m, split=split), ord=order)
+                np.testing.assert_allclose(
+                    float(got.numpy()), np.linalg.norm(m, ord=order), rtol=1e-5
+                )
+
+
+class TestStructure(TestCase):
+    def test_transpose(self):
+        a = self.data((3, 5, 7), 30)
+        for split in (None, 0, 1, 2):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(ht.transpose(x).numpy(), a.T, rtol=1e-6)
+            np.testing.assert_allclose(
+                ht.transpose(x, (1, 2, 0)).numpy(), a.transpose(1, 2, 0), rtol=1e-6
+            )
+
+    def test_tril(self):
+        a = self.data((6, 6), 31)
+        for split in (None, 0, 1):
+            for k in (0, 1, -2):
+                np.testing.assert_allclose(
+                    ht.tril(ht.array(a, split=split), k).numpy(), np.tril(a, k)
+                )
+
+    def test_triu(self):
+        a = self.data((4, 7), 32)
+        for split in (None, 0, 1):
+            for k in (0, -1, 3):
+                np.testing.assert_allclose(
+                    ht.triu(ht.array(a, split=split), k).numpy(), np.triu(a, k)
+                )
+
+    def test_trace(self):
+        a = self.data((6, 6), 33)
+        for split in (None, 0, 1):
+            got = ht.trace(ht.array(a, split=split))  # scalar (reference returns one)
+            np.testing.assert_allclose(float(np.asarray(got)), np.trace(a), rtol=1e-5)
+
+
+class TestSolvesAndFactors(TestCase):
+    def test_det(self):
+        a = self.data((5, 5), 40) + 3 * np.eye(5, dtype=np.float32)
+        for split in (None, 0, 1):
+            np.testing.assert_allclose(
+                float(ht.linalg.det(ht.array(a, split=split)).numpy()),
+                np.linalg.det(a), rtol=1e-3,
+            )
+
+    def test_inv(self):
+        a = self.data((5, 5), 41) + 3 * np.eye(5, dtype=np.float32)
+        for split in (None, 0, 1):
+            np.testing.assert_allclose(
+                ht.linalg.inv(ht.array(a, split=split)).numpy(), np.linalg.inv(a),
+                rtol=1e-3, atol=1e-4,
+            )
+
+    def test_projection(self):
+        a, b = self.data(8, 42), self.data(8, 43)
+        want = (np.dot(a, b) / np.dot(b, b)) * b
+        for split in (None, 0):
+            got = ht.linalg.projection(ht.array(a, split=split), ht.array(b, split=split))
+            np.testing.assert_allclose(got.numpy(), want, rtol=1e-5)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
