@@ -104,30 +104,29 @@ class TestNorms(TestCase):
 
 
 class TestStructure(TestCase):
+    """Unary structure ops ride the harness's assert_func_equal: every split axis
+    is swept AND every device shard is validated against the canonical chunk
+    rule (plus int32/float64 dtype coverage) — per the code-review finding that
+    global-only comparisons miss corrupt hyperslabs."""
+
     def test_transpose(self):
-        a = self.data((3, 5, 7), 30)
-        for split in (None, 0, 1, 2):
-            x = ht.array(a, split=split)
-            np.testing.assert_allclose(ht.transpose(x).numpy(), a.T, rtol=1e-6)
-            np.testing.assert_allclose(
-                ht.transpose(x, (1, 2, 0)).numpy(), a.transpose(1, 2, 0), rtol=1e-6
-            )
+        self.assert_func_equal((3, 5, 7), ht.transpose, np.transpose)
+        self.assert_func_equal(
+            (3, 5, 7), ht.transpose, np.transpose,
+            heat_args={"axes": (1, 2, 0)}, numpy_args={"axes": (1, 2, 0)},
+        )
 
     def test_tril(self):
-        a = self.data((6, 6), 31)
-        for split in (None, 0, 1):
-            for k in (0, 1, -2):
-                np.testing.assert_allclose(
-                    ht.tril(ht.array(a, split=split), k).numpy(), np.tril(a, k)
-                )
+        for k in (0, 1, -2):
+            self.assert_func_equal(
+                (6, 6), ht.tril, np.tril, heat_args={"k": k}, numpy_args={"k": k}
+            )
 
     def test_triu(self):
-        a = self.data((4, 7), 32)
-        for split in (None, 0, 1):
-            for k in (0, -1, 3):
-                np.testing.assert_allclose(
-                    ht.triu(ht.array(a, split=split), k).numpy(), np.triu(a, k)
-                )
+        for k in (0, -1, 3):
+            self.assert_func_equal(
+                (4, 7), ht.triu, np.triu, heat_args={"k": k}, numpy_args={"k": k}
+            )
 
     def test_trace(self):
         a = self.data((6, 6), 33)
