@@ -1,0 +1,170 @@
+"""Dispatch-layer microbenchmark: ops/s for a 64-op elementwise chain.
+
+Measures the framework-level dispatch throughput of the signature-cached jit
+executor (``heat_tpu/core/_executor.py``) against the fully eager path
+(``HEAT_TPU_EAGER_DISPATCH=1``), on the four layouts that exercise every epilogue:
+
+- ``split0_even``   — split array, extent divisible by P (shard-constraint epilogue)
+- ``split0_ragged`` — split array, ragged extent (pad re-mask + physical pad fuse)
+- ``unsplit_even`` / ``unsplit_odd`` — replicated operands (no layout epilogue)
+
+The chain is 16 cycles of ``x = x + y; x = x * 0.5; x = x - y; x = x + 1.0`` —
+64 framework-level binary ops, 4 distinct cached programs, so the steady state is
+pure signature-cache replay. Ops/s is the 64-op chain count over wall-clock around
+a ``block_until_ready`` sync; best of 3.
+
+Standalone (bootstraps a virtual CPU mesh, the conftest pattern):
+
+    python benchmarks/cb/dispatch.py --devices 8 [--check]
+
+``--check`` exits non-zero when the executor path regresses to less than half the
+eager path's ops/s on any case — the CI gate: the cache must never make dispatch
+slower. Also registered with the cb monitor for ``benchmarks/cb/main.py`` runs.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+CHAIN_CYCLES = 16  # 4 ops per cycle → 64-op chain
+N_EVEN = 4096
+N_RAGGED = 4093
+
+
+def _bootstrap(devices: int) -> None:
+    """Re-exec into a hermetic virtual CPU mesh of ``devices`` devices (the test
+    conftest pattern: the flag must be set before the backend initialises, and the
+    container's sitecustomize initialises the TPU backend at startup)."""
+    if os.environ.get("_HEAT_TPU_DISPATCH_BENCH_REEXEC") == "1":
+        return
+    env = dict(os.environ)
+    env["_HEAT_TPU_DISPATCH_BENCH_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # sitecustomize: skip TPU plugin registration
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _chain(ht, x, y):
+    for _ in range(CHAIN_CYCLES):
+        x = x + y
+        x = x * 0.5
+        x = x - y
+        x = x + 1.0
+    return x
+
+
+def _time_chain(ht, jax, x, y, repeats: int = 3) -> float:
+    """Best-of-``repeats`` seconds for one 64-op chain (after a compile warmup)."""
+    jax.block_until_ready(_chain(ht, x, y).parray)  # compile + warmup
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = _chain(ht, x, y)
+        jax.block_until_ready(out.parray)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _cases(ht, jax, jnp):
+    for name, n, split in (
+        ("split0_even", N_EVEN, 0),
+        ("split0_ragged", N_RAGGED, 0),
+        ("unsplit_even", N_EVEN, None),
+        ("unsplit_odd", N_RAGGED, None),
+    ):
+        x = ht.array(
+            jax.random.normal(jax.random.key(0), (n,), jnp.float32), split=split
+        )
+        y = ht.array(
+            jax.random.normal(jax.random.key(1), (n,), jnp.float32) * 0.1, split=split
+        )
+        yield name, x, y
+
+
+def run(check: bool = False, emit=print) -> list:
+    """Run all four layouts, executor vs eager; one JSON-able record per case."""
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+    from heat_tpu.core import _executor
+
+    n_ops = 4 * CHAIN_CYCLES
+    records = []
+    failed = False
+    for name, x, y in _cases(ht, jax, jnp):
+        assert os.environ.get("HEAT_TPU_EAGER_DISPATCH") != "1"
+        jax.block_until_ready(_chain(ht, x, y).parray)  # compile, uncounted
+        _executor.reset_executor_stats()  # so retraces_steady really is steady-state
+        t_exec = _time_chain(ht, jax, x, y)
+        stats = _executor.executor_stats()
+        os.environ["HEAT_TPU_EAGER_DISPATCH"] = "1"
+        try:
+            t_eager = _time_chain(ht, jax, x, y)
+        finally:
+            del os.environ["HEAT_TPU_EAGER_DISPATCH"]
+        rec = {
+            "metric": f"dispatch_chain{n_ops}_{name}_ops_s",
+            "value": round(n_ops / t_exec, 1),
+            "unit": "ops/s",
+            "eager_ops_s": round(n_ops / t_eager, 1),
+            "speedup": round(t_eager / t_exec, 2),
+            "retraces_steady": stats["retraces"],
+            "devices": len(jax.devices()),
+        }
+        records.append(rec)
+        emit(json.dumps(rec))
+        if check and rec["value"] < 0.5 * rec["eager_ops_s"]:
+            failed = True
+            emit(
+                json.dumps(
+                    {
+                        "error": f"{name}: executor {rec['value']} ops/s is below "
+                        f"half the eager path's {rec['eager_ops_s']} ops/s"
+                    }
+                )
+            )
+    if check and failed:
+        sys.exit(1)
+    return records
+
+
+try:  # registered for benchmarks/cb/main.py runs; standalone mode needs no monitor
+    from benchmarks.cb.monitor import monitor
+
+    @monitor("dispatch_chain64")
+    def dispatch_chain64():
+        import jax
+        import jax.numpy as jnp
+
+        import heat_tpu as ht
+
+        name, x, y = next(iter(_cases(ht, jax, jnp)))
+        return _chain(ht, x, y).parray
+except ImportError:  # pragma: no cover - standalone invocation without package path
+    pass
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="exit non-zero if the executor is slower than half the eager path",
+    )
+    args = parser.parse_args()
+    _bootstrap(args.devices)
+    run(check=args.check)

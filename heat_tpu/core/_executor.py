@@ -1,0 +1,504 @@
+"""Signature-cached jit executor for the eager dispatch layer.
+
+The four dispatch wrappers in :mod:`_operations` (``binary_op`` / ``local_op`` /
+``reduce_op`` / ``cum_op``) historically issued their compute, pad re-mask
+(``_zero_pads``), dtype cast and ``comm.shard`` epilogues as *separate* eager XLA
+executions, so the per-op Python + dispatch latency (the ~70 ms tunnel round-trip
+``bench.py`` notes) dominated any small-op workload. This module lets each
+framework-level op resolve to an **abstract signature** and replay a
+``jax.jit``-compiled program for it:
+
+- The signature key is (operation identity, operand avals with weak-type
+  normalisation for scalars, operand logical extents/padded-ness, splits and the
+  out split, ``fn_kwargs``, ``out=``/``where=`` presence, the communicator's
+  mesh). Everything the traced program closes over statically is in the key.
+- On miss the wrapper builds the *whole* chain — compute → pad re-mask → dtype
+  cast → physical pad — as one traced body, jitted with the explicit
+  ``NamedSharding`` output spec from :mod:`communication`, so the mask and cast
+  genuinely fuse into the producing op and the shard constraint costs no extra
+  execution. On hit the call goes straight through jax's C++ dispatch fast path.
+- ``out=`` programs take the destination buffer as their trailing argument and
+  can be compiled with ``donate_argnums`` on it, so in-place-style updates stop
+  allocating a second full shard (see :func:`sanitation.sanitize_donation` for
+  the aliasing-safety contract).
+
+A signature that the executor cannot stage (unhashable kwargs, shapes the padded
+plans reject, …) is cached as *unsupported* so the wrapper falls back to the
+eager path without re-deriving the decision.
+
+**Real fusion — the deferred expression graph.** One XLA execution per
+framework op still pays the backend's per-execution floor 64 times on a 64-op
+chain, so supported elementwise ops (binary/local, no ``out=``/``where=``,
+layout-aligned operands) do not execute at all at call time: they return a
+:class:`Deferred` node recording (operation, operands) plus the result aval
+resolved through a cached ``jax.eval_shape``. The first access to the result's
+physical value (``DNDarray.parray``) **forces** the node: the whole reachable
+graph is linearised, keyed by its structural signature (per-node op identity +
+leaf avals + sharing pattern), and compiled/replayed as ONE program through the
+same signature cache — a 64-op chain becomes one XLA executable per distinct
+chain shape. Interior nodes of a fused graph skip the pad re-mask (pad slots
+may hold garbage mid-program); every *materialised* value is re-masked by its
+root program, so the clean-pad invariant still holds for anything observable.
+
+Escape hatch: ``HEAT_TPU_EAGER_DISPATCH=1`` disables the executor entirely and
+restores the fully eager dispatch path for debugging. Introspection:
+:func:`executor_stats` (hits / misses / retraces / cache size) backs the tests
+and the ``benchmarks/cb/dispatch.py`` microbenchmark.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "executor_stats",
+    "reset_executor_stats",
+    "clear_executor_cache",
+    "executor_enabled",
+]
+
+# Retrace-storm guard: per-call lambdas (now hoisted where we control them) or
+# genuinely polymorphic workloads must not grow the program table without bound.
+_MAX_PROGRAMS = 1024
+
+UNSUPPORTED = object()
+"""Sentinel a ``build`` callback returns (and the cache stores) for signatures the
+executor cannot stage; the wrapper takes the eager path."""
+
+
+class _Stats:
+    __slots__ = ("hits", "misses", "retraces")
+
+    def __init__(self):
+        self.hits = 0
+        self.misses = 0
+        self.retraces = 0
+
+
+_stats = _Stats()
+_programs: "OrderedDict[Any, Any]" = OrderedDict()
+_lock = threading.RLock()
+
+# Warm-up counts for signatures seen but not yet compiled (jit threshold > 1).
+_seen: Dict[Any, int] = {}
+_MAX_SEEN = 8192
+
+
+def jit_threshold() -> int:
+    """How many sightings of a signature before the executor compiles it.
+
+    ``HEAT_TPU_JIT_THRESHOLD=1`` (the default) compiles on first miss — every
+    structurally-identical later call is pure replay. Values >1 let the first
+    ``N-1`` sightings take the original eager path and only compile signatures
+    that prove hot: the right trade for signature-diverse workloads (test
+    suites, exploratory sessions) where most programs would compile once and
+    never replay. Read per call, so it can be flipped in-process."""
+    try:
+        return max(1, int(os.environ.get("HEAT_TPU_JIT_THRESHOLD", "1")))
+    except ValueError:
+        return 1
+
+
+def executor_enabled() -> bool:
+    """Whether dispatch should route through the cached-program executor.
+
+    ``HEAT_TPU_EAGER_DISPATCH=1`` is the debugging escape hatch (read per call so
+    tests can flip it); multi-controller processes always take the eager path —
+    its ``comm.shard`` has the per-process shard-population logic the staged
+    programs do not replicate."""
+    if os.environ.get("HEAT_TPU_EAGER_DISPATCH") == "1":
+        return False
+    return jax.process_count() == 1
+
+
+def executor_stats() -> dict:
+    """Cache introspection: ``hits`` / ``misses`` (signature-table lookups),
+    ``retraces`` (times a program body was actually traced — 0 between two
+    identical calls means the replay was pure cache), and ``programs`` (table
+    size, unsupported-signature entries included)."""
+    return {
+        "hits": _stats.hits,
+        "misses": _stats.misses,
+        "retraces": _stats.retraces,
+        "programs": len(_programs),
+    }
+
+
+def reset_executor_stats() -> None:
+    """Zero the counters (the program table is kept — see
+    :func:`clear_executor_cache`)."""
+    _stats.hits = 0
+    _stats.misses = 0
+    _stats.retraces = 0
+
+
+def clear_executor_cache() -> None:
+    """Drop every cached program (plus warm-up counts and result-aval cache),
+    zero the counters."""
+    with _lock:
+        _programs.clear()
+        _seen.clear()
+        _aval_cache.clear()
+    reset_executor_stats()
+
+
+def kwargs_sig(kwargs: dict):
+    """A hashable signature of an op's ``fn_kwargs``, or :data:`UNSUPPORTED` when
+    a value cannot be hashed (array-valued kwargs etc. stay eager)."""
+    if not kwargs:
+        return ()
+    try:
+        items = tuple(sorted(kwargs.items()))
+        hash(items)
+    except TypeError:
+        return UNSUPPORTED
+    return items
+
+
+def operand_sig(x):
+    """The abstract signature of one program operand.
+
+    Arrays key on (shape, dtype) — their aval; jax's own dispatch re-keys on the
+    concrete layout, so a layout change surfaces as a counted retrace rather than
+    a wrong program. Scalars key on their *type* with weak-type normalisation:
+    two Python floats share a program, a np.float32 scalar gets its own (their
+    promotion semantics differ)."""
+    if isinstance(x, jax.Array):
+        return (x.shape, x.dtype)
+    if isinstance(x, np.ndarray):
+        return (x.shape, x.dtype, "np")
+    if isinstance(x, (np.number, np.bool_)):
+        return ("s", x.dtype)
+    return ("s", type(x).__name__)
+
+
+def op_sig(operation: Callable):
+    """``operation`` itself when hashable (jnp functions — program identity), else
+    :data:`UNSUPPORTED`."""
+    try:
+        hash(operation)
+    except TypeError:
+        return UNSUPPORTED
+    return operation
+
+
+class _Program:
+    """One compiled dispatch program: a traced body plus its jit configuration.
+
+    ``donate_index`` names the trailing ``out=`` buffer argument; the donating
+    and non-donating variants are jitted lazily because donation safety is a
+    per-call property of the destination buffer (see
+    ``sanitation.sanitize_donation``), not of the signature."""
+
+    __slots__ = ("body", "out_shardings", "donate_index", "meta", "_plain", "_donating")
+
+    def __init__(self, body, out_shardings, donate_index, meta):
+        self.body = body
+        self.out_shardings = out_shardings
+        self.donate_index = donate_index
+        self.meta = meta
+        self._plain = None
+        self._donating = None
+
+    def _traced(self):
+        body = self.body
+
+        def counted(*args):
+            _stats.retraces += 1
+            return body(*args)
+
+        return counted
+
+    def __call__(self, *args, donate: bool = False):
+        if donate and self.donate_index is not None:
+            fn = self._donating
+            if fn is None:
+                # keep_unused: a plain out= overwrite never reads the destination
+                # buffer, and jit would otherwise prune the argument and lose the
+                # input/output aliasing the donation exists for
+                fn = self._donating = jax.jit(
+                    self._traced(),
+                    out_shardings=self.out_shardings,
+                    donate_argnums=(self.donate_index,),
+                    keep_unused=True,
+                )
+            return fn(*args)
+        fn = self._plain
+        if fn is None:
+            fn = self._plain = jax.jit(
+                self._traced(),
+                out_shardings=self.out_shardings,
+                keep_unused=self.donate_index is not None,
+            )
+        return fn(*args)
+
+
+def lookup(key, build: Callable[[], Any]) -> Optional[_Program]:
+    """The cached :class:`_Program` for ``key``, building it on miss.
+
+    ``build()`` returns either ``(body, out_shardings, donate_index, meta)`` or
+    :data:`UNSUPPORTED`; both results are cached, so an eager-only signature is
+    rejected in O(1) on every later call. Returns ``None`` for unsupported."""
+    # the whole lookup holds the lock: signature keys hash Python-level objects
+    # (the Mesh), so even the read path could yield the GIL mid-mutation of the
+    # shared OrderedDict; an uncontended RLock costs ~100 ns against a ~40 µs
+    # replay, and compiles were already serialised
+    with _lock:
+        entry = _programs.get(key)
+        if entry is not None:
+            _stats.hits += 1
+            _programs.move_to_end(key)  # eviction is LRU, not FIFO: hits refresh
+            return None if entry is UNSUPPORTED else entry
+        threshold = jit_threshold()
+        if threshold > 1:
+            n = _seen.get(key, 0) + 1
+            if n < threshold:
+                # still warming up: the caller takes the eager path; only a
+                # signature seen `threshold` times earns a compile
+                if len(_seen) >= _MAX_SEEN:
+                    _seen.clear()
+                _seen[key] = n
+                _stats.misses += 1
+                return None
+            _seen.pop(key, None)
+        built = build()
+        if built is UNSUPPORTED:
+            entry = UNSUPPORTED
+        else:
+            entry = _Program(*built)
+        while len(_programs) >= _MAX_PROGRAMS:
+            _programs.popitem(last=False)
+        _programs[key] = entry
+        _stats.misses += 1
+        return None if entry is UNSUPPORTED else entry
+
+
+# ------------------------------------------------------------------ padded layout
+# (shared with _operations — defined here so the deferred-graph force below can
+# re-mask without a circular import)
+
+
+def _pad_mask(physical_shape, n: int, split: int):
+    """Boolean mask, broadcast-shaped ``(1,..,m,..,1)``: True on logical slots along
+    the padded split dimension."""
+    shape = [1] * len(physical_shape)
+    shape[split] = physical_shape[split]
+    return (jnp.arange(physical_shape[split]) < n).reshape(shape)
+
+
+def _zero_pads(value, gshape, split: int):
+    """Restore the clean-pad invariant after computing on a padded physical value."""
+    mask = _pad_mask(value.shape, gshape[split], split)
+    return jnp.where(mask, value, jnp.zeros((), value.dtype))
+
+
+# ------------------------------------------------------------- deferred expression graph
+
+# Deeper graphs amortise better but compile longer and recurse at force time;
+# past the cap a node's pending operands are forced first, starting a fresh graph.
+_MAX_FUSED_NODES = 256
+
+# (op identity, kwargs sig, operand aval sigs) -> (shape, dtype) | UNSUPPORTED.
+# eval_shape traces the op abstractly — far too slow per dispatch, so the result
+# aval is resolved once per signature and replayed.
+_aval_cache: Dict[Any, Any] = {}
+_MAX_AVALS = 4096
+
+
+class Deferred:
+    """A pending node in the executor's fused expression graph.
+
+    ``operands`` entries are ``("d", Deferred)``, ``("a", jax.Array)`` or
+    ``("s", scalar)``; all array-shaped operands are *physical* (padded layout)
+    values of one aligned ``(gshape, split)`` family, so the node evaluates
+    slot-wise with no in-program slicing. ``shape``/``dtype``/``ndim`` expose the
+    node's physical aval (``DNDarray._is_padded`` reads them without forcing).
+    ``value`` memoises the forced result: a node forced as the root of its own
+    program becomes a plain array leaf in any later graph that references it."""
+
+    __slots__ = ("operation", "fn_kwargs", "operands", "shape", "dtype",
+                 "gshape", "split", "comm", "size", "value")
+
+    def __init__(self, operation, fn_kwargs, operands, shape, dtype, gshape, split, comm, size):
+        self.operation = operation
+        self.fn_kwargs = fn_kwargs
+        self.operands = operands
+        self.shape = shape
+        self.dtype = dtype
+        self.gshape = gshape
+        self.split = split
+        self.comm = comm
+        self.size = size
+        self.value = None
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def force(self):
+        """Materialise this node (and everything it transitively needs) as one
+        signature-cached program execution."""
+        if self.value is None:
+            self.value = _force(self)
+        return self.value
+
+
+def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
+    """Build a :class:`Deferred` for ``operation(*operands, **fn_kwargs)``, or
+    :data:`UNSUPPORTED` when the op cannot join a fused graph (unhashable
+    operation/kwargs, non-slot-wise result shape, complex result — the eager
+    paths host-route those).
+
+    The result aval comes from a cached ``eval_shape`` and must equal the
+    physical operand shape: deferral is strictly elementwise over one aligned
+    layout family, everything else takes the immediate one-op staged paths."""
+    op = op_sig(operation)
+    kwsig = kwargs_sig(fn_kwargs)
+    if op is UNSUPPORTED or kwsig is UNSUPPORTED:
+        return UNSUPPORTED
+    phys_shape = None
+    sigs = []
+    for kind, v in operands:
+        if kind == "s":
+            sigs.append(operand_sig(v))
+        else:
+            shape, dtype = (tuple(v.shape), v.dtype)
+            if phys_shape is None:
+                phys_shape = shape
+            elif shape != phys_shape:
+                return UNSUPPORTED  # mixed physical extents: not slot-aligned
+            sigs.append(("t", shape, np.dtype(dtype).str))
+    if phys_shape is None:
+        return UNSUPPORTED
+    akey = (op, kwsig, tuple(sigs))
+    aval = _aval_cache.get(akey)
+    if aval is None:
+        specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for kind, v in operands if kind != "s"]
+
+        def abstract(*xs):
+            it = iter(xs)
+            args = [v if kind == "s" else next(it) for kind, v in operands]
+            return operation(*args, **fn_kwargs)
+
+        try:
+            out = jax.eval_shape(abstract, *specs)
+            aval = (tuple(out.shape), np.dtype(out.dtype))
+        except Exception:
+            aval = UNSUPPORTED
+        if len(_aval_cache) >= _MAX_AVALS:
+            _aval_cache.clear()
+        _aval_cache[akey] = aval
+    if aval is UNSUPPORTED:
+        return UNSUPPORTED
+    shape, dtype = aval
+    if shape != phys_shape or jnp.issubdtype(dtype, jnp.complexfloating):
+        return UNSUPPORTED
+    size = 1
+    for kind, v in operands:
+        if kind == "d" and v.value is None:
+            size += v.size
+    if size > _MAX_FUSED_NODES:
+        # graph grew past the fusion window: materialise the pending operands
+        # (each as its own cached program) and start a fresh graph from leaves
+        operands = tuple(
+            ("a", v.force()) if kind == "d" and v.value is None else (kind, v)
+            for kind, v in operands
+        )
+        size = 1
+    return Deferred(
+        operation, fn_kwargs, tuple(operands), shape, dtype,
+        tuple(gshape), split, comm, size,
+    )
+
+
+def _force(root: Deferred):
+    """Linearise the graph under ``root``, look up / compile its program, run it.
+
+    The structural signature keys on per-node operation identity + kwargs, the
+    leaf avals, and the exact sharing pattern (a leaf or node referenced twice
+    maps to one slot), so two identically-built chains replay one program."""
+    leaves: list = []
+    leaf_index: Dict[Any, int] = {}
+    entries: list = []  # (operation, fn_kwargs, operand refs) in eval order
+    node_index: Dict[int, int] = {}
+
+    def leaf_ref(value):
+        if isinstance(value, jax.Array):
+            k = ("a", id(value))
+        else:
+            try:
+                # repr, not the value: equality would collapse numerically
+                # distinct scalars (-0.0 == 0.0, 1 == True) into one leaf slot
+                k = ("s", type(value), repr(value))
+            except Exception:  # unhashable scalar cannot happen, but stay safe
+                k = ("s", id(value))
+        idx = leaf_index.get(k)
+        if idx is None:
+            idx = len(leaves)
+            leaf_index[k] = idx
+            leaves.append(value)
+        return ("L", idx, operand_sig(value))
+
+    def visit(node: Deferred):
+        idx = node_index.get(id(node))
+        if idx is not None:
+            return ("N", idx)
+        refs = []
+        for kind, v in node.operands:
+            if kind == "d" and v.value is None:
+                refs.append(visit(v))
+            elif kind == "d":
+                refs.append(leaf_ref(v.value))
+            else:
+                refs.append(leaf_ref(v))
+        idx = len(entries)
+        entries.append((node.operation, node.fn_kwargs, tuple(refs)))
+        node_index[id(node)] = idx
+        return ("N", idx)
+
+    visit(root)
+    gshape, split = root.gshape, root.split
+    padded = tuple(root.shape) != gshape
+    key = (
+        "defer", root.comm.mesh, gshape, split,
+        tuple((op_sig(op), kwargs_sig(kw), refs) for op, kw, refs in entries),
+    )
+    plan = tuple(entries)
+    out_shardings = root.comm.sharding(root.ndim, split)
+
+    def build():
+        def body(*leaf_vals):
+            vals = []
+            for operation, fn_kwargs, refs in plan:
+                args = [leaf_vals[r[1]] if r[0] == "L" else vals[r[1]] for r in refs]
+                vals.append(operation(*args, **fn_kwargs))
+            result = vals[-1]
+            if padded:
+                result = _zero_pads(result, gshape, split)
+            return result
+
+        return body, out_shardings, None, None
+
+    prog = lookup(key, build)
+    if prog is None:
+        # signature still under the warm-up jit threshold: evaluate the plan
+        # eagerly — same per-node op order, one re-mask at the root (interior
+        # pad garbage never touches logical slots), layout pinned by comm.shard
+        # exactly like the eager dispatch path
+        vals = []
+        for operation, fn_kwargs, refs in plan:
+            args = [leaves[r[1]] if r[0] == "L" else vals[r[1]] for r in refs]
+            vals.append(operation(*args, **fn_kwargs))
+        result = vals[-1]
+        if padded:
+            result = _zero_pads(result, gshape, split)
+        return root.comm.shard(result, split)
+    return prog(*leaves)

@@ -25,7 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import sanitation, types
+from . import _executor, sanitation, types
 from .communication import get_comm
 from .devices import get_device
 from .dndarray import DNDarray
@@ -50,18 +50,10 @@ Scalar = (int, float, bool, complex, np.number, np.bool_)
 # garbage). Guards like ``jnp.isnan(x.parray).any()`` stay exact under it.
 
 
-def _pad_mask(physical_shape, n: int, split: int):
-    """Boolean mask, broadcast-shaped ``(1,..,m,..,1)``: True on logical slots along
-    the padded split dimension."""
-    shape = [1] * len(physical_shape)
-    shape[split] = physical_shape[split]
-    return (jnp.arange(physical_shape[split]) < n).reshape(shape)
-
-
-def _zero_pads(value, gshape, split: int):
-    """Restore the clean-pad invariant after computing on a padded physical value."""
-    mask = _pad_mask(value.shape, gshape[split], split)
-    return jnp.where(mask, value, jnp.zeros((), value.dtype))
+# shared with the deferred-graph force in _executor (defined there to avoid a
+# circular import); re-exported here for the wrappers and their tests
+_pad_mask = _executor._pad_mask
+_zero_pads = _executor._zero_pads
 
 
 def _is_complexish(*ts) -> bool:
@@ -137,7 +129,7 @@ def handle_out(res: DNDarray, out: Optional[DNDarray], proto: DNDarray) -> DNDar
     if out is None:
         return res
     sanitation.sanitize_out(out, res.gshape, res.split, proto.device)
-    out.larray = proto.comm.shard(_safe_astype(res.larray, out.dtype.jax_type()), out.split)
+    out._rebind_physical(proto.comm.shard(_safe_astype(res.larray, out.dtype.jax_type()), out.split))
     return out
 
 
@@ -199,6 +191,501 @@ def _out_split_binary(out_shape: Tuple[int, ...], *operands: DNDarray) -> Option
     return best
 
 
+# ----------------------------------------------------------------- staged executor
+# The four wrappers stage their whole chain — compute → pad re-mask → dtype cast →
+# physical pad — as ONE signature-cached jit program (_executor), with the output
+# NamedSharding applied by the program itself, so the epilogues genuinely fuse into
+# the producing op instead of running as separate XLA executions. Signatures the
+# stager rejects (and HEAT_TPU_EAGER_DISPATCH=1) fall through to the eager code
+# below, which is the original dispatch path, unchanged.
+
+
+class _StageBail(Exception):
+    """Raised inside a build-time shape probe: this signature takes the eager path."""
+
+
+# --------------------------------------------------------- deferred (fused) dispatch
+# Supported elementwise ops do not execute at call time at all: they append a node
+# to the executor's expression graph (see _executor.Deferred) and the whole chain
+# compiles/replays as ONE program when the result's physical value is first read.
+# Only the strictly slot-aligned case defers — every array operand shares one
+# (gshape, split, comm) family — so no broadcasting, slicing or re-layout ever
+# happens inside a fused graph; everything else takes the immediate one-op staged
+# paths below.
+
+
+def _binary_defer(operation, t1, t2, fn_kwargs):
+    """Append a binary op to the expression graph; NotImplemented → staged/eager."""
+    proto = None
+    raw = []
+    for t in (t1, t2):
+        if isinstance(t, DNDarray):
+            if proto is None:
+                proto = t
+            elif (
+                t.gshape != proto.gshape
+                or t.split != proto.split
+                or t.comm is not proto.comm
+            ):
+                return NotImplemented
+            payload = t._payload
+            raw.append(("d" if isinstance(payload, _executor.Deferred) else "a", payload))
+        elif np.isscalar(t):
+            raw.append(("s", t))
+        else:
+            return NotImplemented
+    if proto is None:
+        return NotImplemented
+    node = _executor.defer_node(
+        operation, fn_kwargs, raw, proto.gshape, proto.split, proto.comm
+    )
+    if node is _executor.UNSUPPORTED:
+        return NotImplemented
+    return DNDarray(
+        node, proto.gshape, types.canonical_heat_type(node.dtype), proto.split,
+        proto.device, proto.comm, True,
+    )
+
+
+def _local_defer(operation, x, fn_kwargs):
+    """Append an elementwise op to the expression graph; NotImplemented → staged."""
+    payload = x._payload
+    node = _executor.defer_node(
+        operation, fn_kwargs,
+        [("d" if isinstance(payload, _executor.Deferred) else "a", payload)],
+        x.gshape, x.split, x.comm,
+    )
+    if node is _executor.UNSUPPORTED:
+        return NotImplemented
+    return DNDarray(
+        node, x.gshape, types.canonical_heat_type(node.dtype), x.split,
+        x.device, x.comm, x.balanced,
+    )
+
+
+def _pad_physical(value, padded_shape: Tuple[int, ...], split: int):
+    """Zero-pad ``value``'s split dimension to the physical padded extent inside a
+    traced program — the staged form of ``comm.shard``'s ragged concatenate."""
+    if tuple(value.shape) == tuple(padded_shape):
+        return value
+    pad_shape = (
+        padded_shape[:split]
+        + (padded_shape[split] - value.shape[split],)
+        + padded_shape[split + 1 :]
+    )
+    return jnp.concatenate([value, jnp.zeros(pad_shape, value.dtype)], axis=split)
+
+
+def _lslice(gshape) -> Tuple[slice, ...]:
+    return tuple(slice(0, s) for s in gshape)
+
+
+def _replicated(value, comm):
+    """Constrain a traced value to the replicated layout. Applied after an in-program
+    logical slice of a padded operand so a staged reduction/scan sees the same
+    (replicated) operand layout the eager path materialises — keeping the partial
+    reduction order, and therefore the float bits, identical to eager dispatch."""
+    return jax.lax.with_sharding_constraint(value, comm.sharding(value.ndim, None))
+
+
+def _binary_jit(
+    operation, t1, t2, a, b, out, where, fn_kwargs, out_shape, out_split, comm, device
+):
+    """Stage a binary op through the executor; NotImplemented → eager path."""
+    op = _executor.op_sig(operation)
+    kwsig = _executor.kwargs_sig(fn_kwargs)
+    if op is _executor.UNSUPPORTED or kwsig is _executor.UNSUPPORTED:
+        return NotImplemented
+    if out is not None and jnp.issubdtype(out.dtype.jax_type(), jnp.complexfloating):
+        return NotImplemented  # _safe_astype may host-route complex targets
+    nd = len(out_shape)
+    phys_shape = comm.padded_shape(out_shape, out_split)
+
+    # ragged fast path: identical operand staging to the eager padded route, with
+    # the re-mask fused into the producing op
+    if out is None and where is None and phys_shape != tuple(out_shape):
+        phys = _padded_physical_operands(((t1, a), (t2, b)), out_shape, out_split, comm)
+        if phys is not None:
+            key = (
+                "b.pad", op, kwsig, tuple(out_shape), out_split, comm.mesh,
+                tuple(_executor.operand_sig(p) for p in phys),
+            )
+
+            def build():
+                def body(x1, x2):
+                    r = operation(x1, x2, **fn_kwargs)
+                    return _zero_pads(r, out_shape, out_split)
+
+                return body, comm.sharding(nd, out_split), None, None
+
+            prog = _executor.lookup(key, build)
+            if prog is None:
+                return NotImplemented
+            value = prog(*phys)
+            return DNDarray(
+                value, tuple(out_shape), types.canonical_heat_type(value.dtype),
+                out_split, device or get_device(), comm, True,
+            )
+
+    # logical path: operands enter physically (padded layouts sliced in-program)
+    vals, slices, sigs = [], [], []
+    for t, arr in ((t1, a), (t2, b)):
+        if np.isscalar(t):
+            vals.append(t)
+            slices.append(None)
+            sigs.append((_executor.operand_sig(t), None))
+        else:
+            vals.append(arr.parray)
+            sl = arr.gshape if arr._is_padded() else None
+            slices.append(sl)
+            sigs.append((_executor.operand_sig(arr.parray), sl))
+    w_sig = None
+    if where is not None:
+        if isinstance(where, DNDarray):
+            wv = where.parray
+            wsl = where.gshape if where._is_padded() else None
+        else:
+            wv = jnp.asarray(where)
+            wsl = None
+        wshape = wsl if wsl is not None else tuple(wv.shape)
+        try:
+            if broadcast_shapes(wshape, out_shape) != tuple(out_shape):
+                return NotImplemented  # where broadcasts beyond the result shape
+        except ValueError:
+            return NotImplemented
+        vals.append(wv)
+        slices.append(wsl)
+        w_sig = (_executor.operand_sig(wv), wsl)
+    out_sig = None
+    donate = False
+    if out is not None:
+        sanitation.sanitize_out(out, out_shape, out_split, device)
+        donate = sanitation.sanitize_donation(out, vals)
+        out_sig = (_executor.operand_sig(out.parray), out._is_padded())
+    key = (
+        "b.log", op, kwsig, tuple(out_shape), out_split, comm.mesh,
+        tuple(sigs), w_sig, out_sig,
+    )
+    has_where = where is not None
+    has_out = out is not None
+    out_dtype = out.dtype.jax_type() if has_out else None
+    out_padded = has_out and out._is_padded()
+
+    def build():
+        op_slices = [None if g is None else _lslice(g) for g in slices]
+        base_slice = _lslice(out_shape) if out_padded else None
+
+        def body(*argv):
+            xs = [
+                v if sl is None else v[sl]
+                for v, sl in zip(argv[: len(op_slices)], op_slices)
+            ]
+            r = operation(xs[0], xs[1], **fn_kwargs)
+            if has_where:
+                w = xs[2]
+                if has_out:
+                    base = argv[-1] if base_slice is None else argv[-1][base_slice]
+                else:
+                    base = jnp.zeros(out_shape, r.dtype)
+                r = jnp.where(w, r, base)
+            if has_out:
+                r = r.astype(out_dtype)
+            if phys_shape != tuple(out_shape):
+                r = _pad_physical(r, phys_shape, out_split)
+            return r
+
+        donate_index = len(op_slices) if has_out else None
+        return body, comm.sharding(nd, out_split), donate_index, None
+
+    prog = _executor.lookup(key, build)
+    if prog is None:
+        return NotImplemented
+    if has_out:
+        value = prog(*vals, out.parray, donate=donate)
+        out._rebind_physical(value)
+        return out
+    value = prog(*vals)
+    return DNDarray(
+        value, tuple(out_shape), types.canonical_heat_type(value.dtype),
+        out_split, device or get_device(), comm, True,
+    )
+
+
+def _local_jit(operation, x, out, fn_kwargs):
+    """Stage an elementwise op through the executor; NotImplemented → eager path."""
+    op = _executor.op_sig(operation)
+    kwsig = _executor.kwargs_sig(fn_kwargs)
+    if op is _executor.UNSUPPORTED or kwsig is _executor.UNSUPPORTED:
+        return NotImplemented
+    if out is not None and jnp.issubdtype(out.dtype.jax_type(), jnp.complexfloating):
+        return NotImplemented
+    comm = x.comm
+    xval = x.parray
+    x_padded = x._is_padded()
+    gshape, split = x.gshape, x.split
+    out_sig = None
+    if out is not None:
+        out_sig = (np.dtype(out.dtype.jax_type()).str,)
+    key = (
+        "l", op, kwsig, _executor.operand_sig(xval), tuple(gshape), split,
+        comm.mesh, out_sig,
+    )
+    has_out = out is not None
+    out_dtype = out.dtype.jax_type() if has_out else None
+
+    def build():
+        aval = jax.ShapeDtypeStruct(xval.shape, xval.dtype)
+        lsl = _lslice(gshape) if x_padded else None
+        if x_padded and not has_out:
+            # padded fast path: same decision rule as the eager route — result
+            # keeps the physical shape and stays non-complex
+            probe = jax.eval_shape(lambda v: operation(v, **fn_kwargs), aval)
+            if tuple(probe.shape) == tuple(xval.shape) and not jnp.issubdtype(
+                probe.dtype, jnp.complexfloating
+            ):
+
+                def body(v):
+                    r = operation(v, **fn_kwargs)
+                    return _zero_pads(r, gshape, split)
+
+                return body, comm.sharding(len(gshape), split), None, ("fast", gshape, split)
+
+        def logical(v):
+            if lsl is not None:
+                v = v[lsl]
+            return operation(v, **fn_kwargs)
+
+        try:
+            probe = jax.eval_shape(logical, aval)
+        except Exception:
+            return _executor.UNSUPPORTED
+        rshape = tuple(probe.shape)
+        if jnp.issubdtype(probe.dtype, jnp.complexfloating):
+            return _executor.UNSUPPORTED  # comm.shard may host-route complex values
+        if has_out:
+            if rshape != tuple(gshape):
+                return _executor.UNSUPPORTED
+            phys = comm.padded_shape(gshape, split)
+
+            def body(v, ob):
+                r = logical(v).astype(out_dtype)
+                if phys != tuple(gshape):
+                    r = _pad_physical(r, phys, split)
+                return r
+
+            return body, comm.sharding(len(gshape), split), 1, ("out", gshape, split)
+        if split is not None and split >= len(rshape):
+            return _executor.UNSUPPORTED  # eager raises on the out-of-range spec
+        phys = comm.padded_shape(rshape, split)
+
+        def body(v):
+            r = logical(v)
+            if phys != rshape:
+                r = _pad_physical(r, phys, split)
+            return r
+
+        return body, comm.sharding(len(rshape), split), None, ("wrap", rshape, split)
+
+    prog = _executor.lookup(key, build)
+    if prog is None:
+        return NotImplemented
+    kind, rshape, rsplit = prog.meta
+    if kind == "out":
+        sanitation.sanitize_out(out, gshape, split, x.device)
+        donate = sanitation.sanitize_donation(out, [xval])
+        value = prog(xval, out.parray, donate=donate)
+        out._rebind_physical(value)
+        return out
+    value = prog(xval)
+    return DNDarray(
+        value, tuple(rshape), types.canonical_heat_type(value.dtype), rsplit,
+        x.device, x.comm, x.balanced,
+    )
+
+
+def _reduce_jit(operation, x, axis, out_split, out, keepdims, fn_kwargs):
+    """Stage a reduction through the executor; NotImplemented → eager path."""
+    op = _executor.op_sig(operation)
+    kwsig = _executor.kwargs_sig(fn_kwargs)
+    if op is _executor.UNSUPPORTED or kwsig is _executor.UNSUPPORTED:
+        return NotImplemented
+    if out is not None and jnp.issubdtype(out.dtype.jax_type(), jnp.complexfloating):
+        return NotImplemented
+    comm = x.comm
+    xval = x.parray
+    x_padded = x._is_padded()
+    gshape, split = x.gshape, x.split
+    has_out = out is not None
+    out_dtype = out.dtype.jax_type() if has_out else None
+    key = (
+        "r", op, kwsig, _executor.operand_sig(xval), tuple(gshape), split, axis,
+        keepdims, comm.mesh,
+        (np.dtype(out_dtype).str,) if has_out else None,
+    )
+
+    def build():
+        aval = jax.ShapeDtypeStruct(xval.shape, xval.dtype)
+        if x_padded and not has_out:
+            meta_box = {}
+
+            def probe(v):
+                r = _padded_reduce_value(
+                    operation, v, gshape, split, axis, out_split, keepdims, fn_kwargs
+                )
+                if r is None:
+                    raise _StageBail()
+                meta_box["shape"], meta_box["split"] = r[1], r[2]
+                return r[0]
+
+            try:
+                rsd = jax.eval_shape(probe, aval)
+                if jnp.issubdtype(rsd.dtype, jnp.complexfloating):
+                    raise _StageBail()
+
+                def body(v):
+                    return _padded_reduce_value(
+                        operation, v, gshape, split, axis, out_split, keepdims, fn_kwargs
+                    )[0]
+
+                return (
+                    body,
+                    comm.sharding(len(rsd.shape), meta_box["split"]),
+                    None,
+                    ("wrap", meta_box["shape"], meta_box["split"]),
+                )
+            except _StageBail:
+                pass
+
+        lsl = _lslice(gshape) if x_padded else None
+
+        def logical(v):
+            if lsl is not None:
+                # replicate like the eager larray materialisation so the staged
+                # reduction combines partials in the same order (bit parity)
+                v = _replicated(v[lsl], comm)
+            return operation(v, axis=axis, keepdims=keepdims, **fn_kwargs)
+
+        try:
+            rsd = jax.eval_shape(logical, aval)
+        except Exception:
+            return _executor.UNSUPPORTED
+        rshape = tuple(rsd.shape)
+        if jnp.issubdtype(rsd.dtype, jnp.complexfloating):
+            return _executor.UNSUPPORTED
+        fsplit = out_split if (out_split is None or out_split < len(rshape)) else None
+        phys = comm.padded_shape(rshape, fsplit)
+        if has_out:
+
+            def body(v, ob):
+                r = logical(v).astype(out_dtype)
+                if phys != rshape:
+                    r = _pad_physical(r, phys, fsplit)
+                return r
+
+            return body, comm.sharding(len(rshape), fsplit), 1, ("out", rshape, fsplit)
+
+        def body(v):
+            r = logical(v)
+            if phys != rshape:
+                r = _pad_physical(r, phys, fsplit)
+            return r
+
+        return body, comm.sharding(len(rshape), fsplit), None, ("wrap", rshape, fsplit)
+
+    prog = _executor.lookup(key, build)
+    if prog is None:
+        return NotImplemented
+    kind, rshape, fsplit = prog.meta
+    if kind == "out":
+        sanitation.sanitize_out(out, rshape, fsplit, x.device)
+        donate = sanitation.sanitize_donation(out, [xval])
+        value = prog(xval, out.parray, donate=donate)
+        out._rebind_physical(value)
+        return out
+    value = prog(xval)
+    return DNDarray(
+        value, tuple(rshape), types.canonical_heat_type(value.dtype), fsplit,
+        x.device, x.comm, True,
+    )
+
+
+def _cum_jit(operation, x, axis, out, target, fn_kwargs):
+    """Stage a cumulative op through the executor; NotImplemented → eager path."""
+    op = _executor.op_sig(operation)
+    kwsig = _executor.kwargs_sig(fn_kwargs)
+    if op is _executor.UNSUPPORTED or kwsig is _executor.UNSUPPORTED:
+        return NotImplemented
+    if target is not None and jnp.issubdtype(target, jnp.complexfloating):
+        return NotImplemented
+    if out is not None and jnp.issubdtype(out.dtype.jax_type(), jnp.complexfloating):
+        return NotImplemented
+    comm = x.comm
+    xval = x.parray
+    x_padded = x._is_padded()
+    gshape, split = x.gshape, x.split
+    nd = len(gshape)
+    has_out = out is not None
+    out_dtype = out.dtype.jax_type() if has_out else None
+    key = (
+        "c", op, kwsig, _executor.operand_sig(xval), tuple(gshape), split, axis,
+        np.dtype(target).str if target is not None else None, comm.mesh,
+        (np.dtype(out_dtype).str,) if has_out else None,
+    )
+
+    def build():
+        lsl = _lslice(gshape) if x_padded else None
+        if x_padded and not has_out:
+
+            def body(v):
+                if target is not None:
+                    v = v.astype(target)
+                r = operation(v, axis=axis, **fn_kwargs)
+                return _zero_pads(r, gshape, split)
+
+            return body, comm.sharding(nd, split), None, ("fast",)
+        phys = comm.padded_shape(gshape, split)
+
+        def logical(v):
+            if lsl is not None:
+                v = _replicated(v[lsl], comm)
+            if target is not None:
+                v = v.astype(target)
+            return operation(v, axis=axis, **fn_kwargs)
+
+        if has_out:
+
+            def body(v, ob):
+                r = logical(v).astype(out_dtype)
+                if phys != tuple(gshape):
+                    r = _pad_physical(r, phys, split)
+                return r
+
+            return body, comm.sharding(nd, split), 1, ("out",)
+
+        def body(v):
+            r = logical(v)
+            if phys != tuple(gshape):
+                r = _pad_physical(r, phys, split)
+            return r
+
+        return body, comm.sharding(nd, split), None, ("wrap",)
+
+    prog = _executor.lookup(key, build)
+    if prog is None:
+        return NotImplemented
+    if prog.meta == ("out",):
+        sanitation.sanitize_out(out, gshape, split, x.device)
+        donate = sanitation.sanitize_donation(out, [xval])
+        value = prog(xval, out.parray, donate=donate)
+        out._rebind_physical(value)
+        return out
+    value = prog(xval)
+    return DNDarray(
+        value, tuple(gshape), types.canonical_heat_type(value.dtype), split,
+        x.device, x.comm, x.balanced,
+    )
+
+
 def binary_op(
     operation: Callable,
     t1,
@@ -223,12 +710,31 @@ def binary_op(
         if isinstance(t, DNDarray):
             comm, device = t.comm, t.device
             break
+    # fused deferral first: the aligned elementwise case never wraps scalars into
+    # DNDarrays (a per-call device_put) and never executes — it grows the graph
+    if (
+        out is None
+        and where is None
+        and _executor.executor_enabled()
+        and not _is_complexish(t1, t2)
+    ):
+        res = _binary_defer(operation, t1, t2, fn_kwargs)
+        if res is not NotImplemented:
+            return res
     a = _ensure_dndarray(t1, device, comm)
     b = _ensure_dndarray(t2, device, comm)
 
     out_shape = broadcast_shapes(a.gshape, b.gshape)
     out_split = _out_split_binary(out_shape, a, b)
     use_comm = comm or get_comm()
+
+    if _executor.executor_enabled() and not _is_complexish(t1, t2, a, b):
+        res = _binary_jit(
+            operation, t1, t2, a, b, out, where, fn_kwargs,
+            out_shape, out_split, use_comm, device,
+        )
+        if res is not NotImplemented:
+            return res
 
     # ragged fast path: compute on the padded physical values so per-device memory
     # stays O(n/P) (the logical slice below resolves to a replicated value)
@@ -276,7 +782,7 @@ def binary_op(
     if out is not None:
         sanitation.sanitize_out(out, out_shape, out_split, device)
         result = use_comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)
-        out.larray = result
+        out._rebind_physical(result)
         return out
     result = use_comm.shard(result, out_split)
     return DNDarray(
@@ -295,6 +801,14 @@ def local_op(
 ) -> DNDarray:
     """Elementwise operation, no communication (reference ``__local_op`` ``:331``)."""
     sanitation.sanitize_in(x)
+    if _executor.executor_enabled() and not _is_complexish(x):
+        if out is None:
+            res = _local_defer(operation, x, fn_kwargs)
+            if res is not NotImplemented:
+                return res
+        res = _local_jit(operation, x, out, fn_kwargs)
+        if res is not NotImplemented:
+            return res
     if x._is_padded() and out is None and not _is_complexish(x):
         # ragged fast path: elementwise on the padded physical value keeps shards 1/P;
         # pad slots compute garbage in registers and are re-zeroed by the fused mask
@@ -370,13 +884,18 @@ def _neutral_scalar(kind: str, dtype):
     return jnp.asarray(-jnp.inf if kind == "lowest" else jnp.inf, dtype)
 
 
-def _padded_reduce(operation, x: DNDarray, axis, out_split, keepdims, fn_kwargs):
-    """Reduce a padded-physical array without materialising the logical (replicated)
-    value — or return None when ``operation`` has no pad-safe form. Mean/std/var get
-    count-corrected forms (pad slots must not inflate the element count)."""
-    axes = tuple(range(x.ndim)) if axis is None else (axis if isinstance(axis, tuple) else (axis,))
-    phys = x.parray
-    split = x.split
+def _padded_reduce_value(
+    operation, phys, gshape, split, axis, out_split, keepdims, fn_kwargs
+):
+    """The value half of :func:`_padded_reduce`: reduce a padded physical value
+    ``phys`` (concrete or traced — shape checks are static) without materialising
+    the logical (replicated) form, or return None when ``operation`` has no
+    pad-safe form. Returns ``(value, out_shape, final_split)``; the caller lays
+    the value out (``comm.shard`` eagerly, ``out_shardings`` when staged)."""
+    axes = (
+        tuple(range(len(gshape))) if axis is None
+        else (axis if isinstance(axis, tuple) else (axis,))
+    )
     if split not in axes:
         # the padded dim survives: pad rows reduce to garbage in output pad slots,
         # which the mask re-zeroes; logical slots never mix with pads
@@ -384,28 +903,28 @@ def _padded_reduce(operation, x: DNDarray, axis, out_split, keepdims, fn_kwargs)
             return None
         result = operation(phys, axis=axis, keepdims=keepdims, **fn_kwargs)
         if keepdims:
-            out_shape = tuple(1 if i in axes else s for i, s in enumerate(x.gshape))
+            out_shape = tuple(1 if i in axes else s for i, s in enumerate(gshape))
         else:
-            out_shape = tuple(s for i, s in enumerate(x.gshape) if i not in axes)
+            out_shape = tuple(s for i, s in enumerate(gshape) if i not in axes)
         if out_split >= len(out_shape):
             return None
         expected = out_shape[:out_split] + (phys.shape[split],) + out_shape[out_split + 1 :]
         if tuple(result.shape) != expected:
             return None
         result = _zero_pads(result, out_shape, out_split)
-        result = x.comm.shard(result, out_split)
-        return DNDarray(
-            result, out_shape, types.canonical_heat_type(result.dtype), out_split,
-            x.device, x.comm, True,
-        )
+        return result, out_shape, out_split
     # the padded dim is reduced away: fill pad slots with the op's neutral element
-    mask = _pad_mask(phys.shape, x.gshape[split], split)
-    n_count = int(np.prod([x.gshape[ax] for ax in axes])) if axes else 1
+    mask = _pad_mask(phys.shape, gshape[split], split)
+    n_count = int(np.prod([gshape[ax] for ax in axes])) if axes else 1
     if operation is jnp.mean:
         # sum/n, not mean*(m/n): one rounding, and exact for n == 1
         masked0 = jnp.where(mask, phys, jnp.zeros((), phys.dtype))
         result = jnp.sum(masked0, axis=axis, keepdims=keepdims, **fn_kwargs) / n_count
     elif operation in (jnp.std, jnp.var):
+        if any(k != "ddof" for k in fn_kwargs):
+            # e.g. dtype= would be silently dropped here while the logical path
+            # honors it — bail out so results stay layout-independent (ADVICE r5 #3)
+            return None
         masked0 = jnp.where(mask, phys, jnp.zeros((), phys.dtype))
         mu = jnp.sum(masked0, axis=axis, keepdims=True) / n_count
         d = jnp.where(mask, phys.astype(mu.dtype) - mu, jnp.zeros((), mu.dtype))
@@ -418,9 +937,22 @@ def _padded_reduce(operation, x: DNDarray, axis, out_split, keepdims, fn_kwargs)
             return None
         masked = jnp.where(mask, phys, _neutral_scalar(kind, phys.dtype))
         result = operation(masked, axis=axis, keepdims=keepdims, **fn_kwargs)
-    result = x.comm.shard(result, out_split)
+    return result, tuple(result.shape), out_split
+
+
+def _padded_reduce(operation, x: DNDarray, axis, out_split, keepdims, fn_kwargs):
+    """Reduce a padded-physical array without materialising the logical (replicated)
+    value — or return None when ``operation`` has no pad-safe form. Mean/std/var get
+    count-corrected forms (pad slots must not inflate the element count)."""
+    r = _padded_reduce_value(
+        operation, x.parray, x.gshape, x.split, axis, out_split, keepdims, fn_kwargs
+    )
+    if r is None:
+        return None
+    result, out_shape, final_split = r
+    result = x.comm.shard(result, final_split)
     return DNDarray(
-        result, tuple(result.shape), types.canonical_heat_type(result.dtype), out_split,
+        result, out_shape, types.canonical_heat_type(result.dtype), final_split,
         x.device, x.comm, True,
     )
 
@@ -442,6 +974,10 @@ def reduce_op(
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.gshape, axis)
     out_split = _out_split_reduce(x, axis, keepdims)
+    if _executor.executor_enabled() and not _is_complexish(x):
+        res = _reduce_jit(operation, x, axis, out_split, out, keepdims, fn_kwargs)
+        if res is not NotImplemented:
+            return res
     if x._is_padded() and out is None:
         res = _padded_reduce(operation, x, axis, out_split, keepdims, fn_kwargs)
         if res is not None:
@@ -475,6 +1011,10 @@ def cum_op(
     if axis is None:
         raise NotImplementedError("cumulative operations require an explicit axis")
     target = types.canonical_heat_type(dtype).jax_type() if dtype is not None else None
+    if _executor.executor_enabled() and not _is_complexish(x):
+        res = _cum_jit(operation, x, axis, out, target, fn_kwargs)
+        if res is not NotImplemented:
+            return res
     if (
         x._is_padded()
         and out is None

@@ -266,6 +266,19 @@ class MeshCommunication(Communication):
         c = -(-n // self.size) if n else 0
         return c * self.size
 
+    def padded_shape(
+        self, shape: Sequence[int], split: Optional[int]
+    ) -> Tuple[int, ...]:
+        """The physical shape of a logical ``shape`` laid out along ``split``: the
+        split dimension rounded up to :meth:`padded_dim`, every other dimension
+        unchanged. Equals ``shape`` for ``split=None`` and divisible extents. The
+        static half of :meth:`shard` — the dispatch executor (``_executor``) uses
+        it to stage the physical pad inside a jitted program."""
+        shape = tuple(int(s) for s in shape)
+        if split is None or split >= len(shape):
+            return shape
+        return shape[:split] + (self.padded_dim(shape[split]),) + shape[split + 1 :]
+
     def shard(self, array: jax.Array, split: Optional[int]) -> jax.Array:
         """Lay ``array`` out with dimension ``split`` sharded over the mesh.
 

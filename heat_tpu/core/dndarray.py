@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from . import types
+from ._executor import Deferred
 from .communication import Communication, MeshCommunication, get_comm
 from .devices import Device, get_device
 from .stride_tricks import sanitize_axis
@@ -122,9 +123,9 @@ class DNDarray:
         the eager slice materialises a replicated temporary — callers that care about
         per-device memory should consume :attr:`parray` / :meth:`iter_shards`."""
         if not self._is_padded():
-            return self.__array
+            return self.parray
         sl = tuple(slice(0, s) for s in self.__gshape)
-        return self.__array[sl]
+        return self.parray[sl]
 
     @property
     def larray(self) -> jax.Array:
@@ -163,17 +164,53 @@ class DNDarray:
         try:
             return array.sharding == self.__comm.sharding(array.ndim, self.__split)
         except AttributeError:
-            # tracer under jit: internal padded rebinds come from comm.shard, whose
-            # device_put lowers to exactly this sharding — treat as a match
-            return True
+            # tracer under jit: a traced value has no committed sharding to inspect,
+            # so the padded-layout interpretation cannot be inferred here. Internal
+            # producers of padded physical values (comm.shard consumers, the
+            # dispatch executor) declare their intent via _rebind_physical instead
+            # of relying on shape coincidence (ADVICE r5 #1).
+            return False
         except Exception:
             return False
+
+    def _rebind_physical(self, array: jax.Array) -> None:
+        """Rebind the payload with a value **known by the caller** to be the
+        physical form of the *current* ``(gshape, split)`` — logical shape, or the
+        padded layout ``comm.shard`` / the dispatch executor produce for it. This
+        is the internal path that replaces the larray setter's layout-inference
+        heuristic: intent is declared, not guessed from shape equality, so it also
+        works for traced values under jit (where ``_sharding_matches`` cannot).
+        Dtype may differ (out=-style casts rebind through here); gshape and split
+        never change."""
+        shape = tuple(array.shape)
+        if shape != self.__gshape and shape != self._padded_gshape():
+            raise ValueError(
+                f"_rebind_physical: value shape {shape} is neither the logical "
+                f"gshape {self.__gshape} nor its padded layout {self._padded_gshape()}"
+            )
+        self.__array = array
+        self.__dtype = types.canonical_heat_type(array.dtype)
 
     @property
     def parray(self) -> jax.Array:
         """The physical ``jax.Array`` as laid out in device memory — equal to
         :attr:`larray` except for ragged split extents, where the split dimension is
-        zero-padded to ``ceil(n/P)*P`` so shards are an exact 1/P."""
+        zero-padded to ``ceil(n/P)*P`` so shards are an exact 1/P.
+
+        A payload deferred by the dispatch executor (a pending fused-op graph
+        node) is **forced** here: the whole chain compiles/replays as one
+        program and the concrete result replaces the node."""
+        arr = self.__array
+        if isinstance(arr, Deferred):
+            arr = arr.force()
+            self.__array = arr
+        return arr
+
+    @property
+    def _payload(self):
+        """The raw payload WITHOUT forcing: a concrete ``jax.Array`` or a pending
+        :class:`~._executor.Deferred` node. Only the dispatch layer should read
+        this — everything else wants :attr:`parray`."""
         return self.__array
 
     @property
@@ -192,7 +229,7 @@ class DNDarray:
         array, with indices and values trimmed to the logical gshape. Pure-padding
         shards are skipped. The backbone for per-shard I/O and per-shard algorithms
         (reference: rank-local hyperslabs, ``io.py:211-238``)."""
-        for shard in self.__array.addressable_shards:
+        for shard in self.parray.addressable_shards:
             if shard.index is None:
                 continue
             trimmed = []
@@ -320,7 +357,7 @@ class DNDarray:
 
     @property
     def lloc(self) -> LocalIndex:
-        return LocalIndex(self.__array)
+        return LocalIndex(self.parray)
 
     @property
     def __partitioned__(self) -> dict:
@@ -406,7 +443,7 @@ class DNDarray:
         replicates by definition, so it takes the plain path; the unpadded path
         is one re-sharding as before."""
         if self._is_padded() and axis is not None and axis != self.__split:
-            moved = self.__comm.shard(self.__array, axis)
+            moved = self.__comm.shard(self.parray, axis)
             sl = tuple(
                 slice(0, s) if d == self.__split else slice(None)
                 for d, s in enumerate(self.__gshape)
@@ -419,7 +456,7 @@ class DNDarray:
         axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return DNDarray(
-                self.__array, self.__gshape, self.__dtype, axis, self.__device,
+                self.parray, self.__gshape, self.__dtype, axis, self.__device,
                 self.__comm, True,
             )
         new = self._reshard(axis)
@@ -453,7 +490,7 @@ class DNDarray:
             idx = tuple(
                 slice(a, b) if i == ax else slice(None) for i in range(self.ndim)
             )
-            return self.__array[idx]
+            return self.parray[idx]
 
         self.__halo_prev = _slab(max(start - halo_size, 0), start) if (prev and start > 0) else None
         self.__halo_next = (
@@ -472,7 +509,7 @@ class DNDarray:
     def array_with_halos(self) -> jax.Array:
         """Local chunk with fetched halos attached (reference ``dndarray.py:360``)."""
         _, _, slices = self.__comm.chunk(self.__gshape, self.__split)
-        local = self.__array[slices] if self.__split is not None else self.__array
+        local = self.parray[slices] if self.__split is not None else self.parray
         parts = [p for p in (self.__halo_prev, local, self.__halo_next) if p is not None]
         if len(parts) == 1:
             return parts[0]
@@ -484,7 +521,7 @@ class DNDarray:
         from ._operations import _safe_astype
 
         dtype = types.canonical_heat_type(dtype)
-        casted = _safe_astype(self.__array, dtype.jax_type())
+        casted = _safe_astype(self.parray, dtype.jax_type())
         casted = self.__comm.shard(casted, self.__split)
         if copy:
             return DNDarray(casted, self.__gshape, dtype, self.__split, self.__device, self.__comm, self.__balanced)
@@ -496,7 +533,7 @@ class DNDarray:
         """The single element as a Python scalar (reference ``dndarray.py:1144``)."""
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
-        if not self.__array.is_fully_addressable:
+        if not self.parray.is_fully_addressable:
             return self.numpy().reshape(()).item()
         return self._logical().reshape(()).item()
 
@@ -507,11 +544,11 @@ class DNDarray:
         (``jax.process_count() > 1``), the value is fetched with a cross-host
         ``process_allgather`` so every controller returns the same global array —
         the TPU form of the reference's rank-0 gather + Bcast."""
-        if self.__array.is_fully_addressable:
+        if self.parray.is_fully_addressable:
             return np.asarray(self._logical())
         from jax.experimental import multihost_utils
 
-        full = np.asarray(multihost_utils.process_allgather(self.__array, tiled=True))
+        full = np.asarray(multihost_utils.process_allgather(self.parray, tiled=True))
         if full.shape != self.__gshape:  # strip layout padding gathered from shards
             full = full[tuple(slice(0, s) for s in self.__gshape)]
         return full
@@ -541,7 +578,7 @@ class DNDarray:
             partitions[pos] = {
                 "start": tuple(sl.start or 0 for sl in slices),
                 "shape": tuple(lshape),
-                "data": None if no_data else self.__array[slices],
+                "data": None if no_data else self.parray[slices],
                 "location": [r],
                 "dtype": np.dtype(self.__dtype.jax_type()),
             }
@@ -563,7 +600,7 @@ class DNDarray:
             raise ValueError("fill_diagonal requires a 2-D DNDarray")
         n = min(self.__gshape)
         idx = jnp.arange(n)
-        new = self.__array.at[idx, idx].set(jnp.asarray(value, dtype=self.__array.dtype))
+        new = self.parray.at[idx, idx].set(jnp.asarray(value, dtype=self.parray.dtype))
         self.__array = self.__comm.shard(new, self.__split)
         return self
 
@@ -638,7 +675,7 @@ class DNDarray:
         jkey = _jaxify_key(key)
         if isinstance(value, DNDarray):
             value = value.larray
-        value = jnp.asarray(value, dtype=self.__array.dtype)
+        value = jnp.asarray(value, dtype=self.parray.dtype)
         new = self._logical().at[jkey].set(value)
         self.__array = self.__comm.shard(new, self.__split)
 

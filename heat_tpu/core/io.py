@@ -358,9 +358,18 @@ def _compose_netcdf_slices(file_slices, gshape, var_shape, unlimited):
         if start < 0:
             start += vs
         if fs.stop is None:
-            # cover the data extent exactly; on an unlimited dimension this may
-            # grow the file
-            stop = start + step * gshape[d]
+            if unlimited[d]:
+                # cover the data extent exactly; on an unlimited dimension this
+                # may grow the file — that is the append
+                stop = start + step * gshape[d]
+            else:
+                # numpy/netCDF semantics: an omitted stop on a limited dimension
+                # addresses the WHOLE remaining extent. If the existing variable
+                # is larger than the data, the length check below rejects the
+                # keys, so the caller raises the explicit extent-mismatch error
+                # instead of silently prefix-writing (ADVICE r5 #5; plain
+                # netCDF4 assignment would raise a broadcast error here too).
+                stop = vs
         else:
             stop = fs.stop + vs if fs.stop < 0 else fs.stop
         rng = range(start, stop, step)

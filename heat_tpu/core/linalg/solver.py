@@ -44,7 +44,7 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     x_val = _cg_run(A.larray.astype(dt), b.larray.astype(dt), x0.larray.astype(dt))
     x = factories.array(x_val, split=b.split, device=b.device, comm=b.comm)
     if out is not None:
-        out.larray = out.comm.shard(x.larray.astype(out.larray.dtype), out.split)
+        out._rebind_physical(out.comm.shard(x.larray.astype(out.larray.dtype), out.split))
         return out
     return x
 
@@ -179,9 +179,9 @@ def lanczos(
         V_rows.T, dtype=out_dtype, split=None, device=A.device, comm=A.comm
     )
     if V_out is not None:
-        V_out.larray = V_out.comm.shard(V_dnd.larray.astype(V_out.larray.dtype), V_out.split)
+        V_out._rebind_physical(V_out.comm.shard(V_dnd.larray.astype(V_out.larray.dtype), V_out.split))
         V_dnd = V_out
     if T_out is not None:
-        T_out.larray = T_out.comm.shard(T.larray.astype(T_out.larray.dtype), T_out.split)
+        T_out._rebind_physical(T_out.comm.shard(T.larray.astype(T_out.larray.dtype), T_out.split))
         return V_dnd, T_out
     return V_dnd, T

@@ -540,7 +540,11 @@ def topk(
         and not jnp.issubdtype(a.parray.dtype, jnp.complexfloating)
     ):
         values, idx = _topk_split(a, k, dim, largest)
-        split = None  # the k results are replicated, like the reference's final bcast
+        # DEVIATION (doc/source/deviations.rst): the replicated candidate-reduction
+        # result is returned with split=None, whereas the reference re-creates the
+        # output with split=a.split when dim == split (reference
+        # manipulations.py:4105-4112); resplit explicitly for that layout
+        split = None
         v = _wrap(values, a, split)
         i = _wrap(idx.astype(jnp.int64), a, split)
         if out is not None:

@@ -15,7 +15,10 @@ def copy(x: DNDarray) -> DNDarray:
     if not isinstance(x, DNDarray):
         raise TypeError(f"input needs to be a DNDarray, got {type(x)}")
     # parray, not larray: slicing a ragged array's padding off resolves to a
-    # replicated value — the copy must keep the 1/P padded physical layout
+    # replicated value — the copy must keep the 1/P padded physical layout.
+    # Source and copy share one immutable buffer object; the dispatch executor's
+    # out= donation stays safe because sanitation.sanitize_donation's refcount
+    # guard sees the sibling's reference for as long as it is alive.
     return DNDarray(x.parray, x.gshape, x.dtype, x.split, x.device, x.comm, x.balanced)
 
 

@@ -47,10 +47,24 @@ def floor(x, out=None) -> DNDarray:
     return _operations.local_op(jnp.floor, x, out)
 
 
+# module-level (not per-call lambdas): the dispatch executor caches compiled
+# programs by operation identity, and a fresh lambda per call would never hit
+def _modf_frac(v):
+    return jnp.modf(v)[0]
+
+
+def _modf_int(v):
+    return jnp.modf(v)[1]
+
+
+def _sign_real(v):
+    return jnp.sign(v.real).astype(v.dtype)
+
+
 def modf(x: DNDarray, out=None):
     """Fractional and integral parts (reference ``rounding.py`` modf)."""
-    frac = _operations.local_op(lambda v: jnp.modf(v)[0], x, out[0] if out else None)
-    intg = _operations.local_op(lambda v: jnp.modf(v)[1], x, out[1] if out else None)
+    frac = _operations.local_op(_modf_frac, x, out[0] if out else None)
+    intg = _operations.local_op(_modf_int, x, out[1] if out else None)
     return frac, intg
 
 
@@ -69,7 +83,7 @@ def sgn(x, out=None) -> DNDarray:
 def sign(x, out=None) -> DNDarray:
     """Sign; complex inputs use sign of the real part (reference ``rounding.py`` sign)."""
     if isinstance(x, DNDarray) and types.heat_type_is_complexfloating(x.dtype):
-        return _operations.local_op(lambda v: jnp.sign(v.real).astype(v.dtype), x, out)
+        return _operations.local_op(_sign_real, x, out)
     return _operations.local_op(jnp.sign, x, out)
 
 

@@ -14,6 +14,7 @@ from .dndarray import DNDarray
 
 __all__ = [
     "sanitize_sequence",
+    "sanitize_donation",
     "sanitize_in",
     "sanitize_infinity",
     "sanitize_in_tensor",
@@ -68,6 +69,36 @@ def sanitize_out(
     if out.split != output_split:
         # match the reference behaviour: resplit the out buffer to the required split
         out.resplit_(output_split)
+
+
+def sanitize_donation(out: DNDarray, operand_arrays: Sequence) -> bool:
+    """Whether ``out``'s physical buffer may be **donated** to a jitted ``out=``
+    program (the dispatch executor's ``donate_argnums`` path).
+
+    Donation invalidates the donated ``jax.Array`` object, so it is only safe
+    when no *other* live consumer can still read it. The contract:
+
+    - the buffer must not also be a program operand (``ht.add(a, b, out=a)``
+      reads ``a`` — aliasing the read with the write is not guaranteed safe);
+    - no references beyond the ``out`` array itself and this call chain may
+      exist (``sys.getrefcount`` guard — a user holding ``buf = x.parray``,
+      or a ``memory.copy`` sibling sharing the buffer object, keeps the buffer
+      alive and undonatable for exactly as long as that holder exists).
+      Callers must therefore check *before* putting the buffer into their own
+      argument list.
+
+    When this returns False the program still runs, just without the
+    input/output aliasing — correctness never depends on donation.
+    """
+    import sys
+
+    buf = out.parray
+    if any(buf is arr for arr in operand_arrays):
+        return False
+    # expected references: the DNDarray's private attribute, the ``buf`` local,
+    # and the getrefcount argument itself. Anything beyond that is an external
+    # holder we must not invalidate.
+    return sys.getrefcount(buf) <= 3
 
 
 def sanitize_distribution(

@@ -109,7 +109,7 @@ class SplitTiles:
     def __setitem__(self, key, value) -> None:
         sl = self._tile_slices(key)
         new = self.__arr.larray.at[sl].set(jnp.asarray(value, self.__arr.larray.dtype))
-        self.__arr.larray = self.__arr.comm.shard(new, self.__arr.split)
+        self.__arr._rebind_physical(self.__arr.comm.shard(new, self.__arr.split))
 
 
 class SquareDiagTiles:
@@ -293,7 +293,7 @@ class SquareDiagTiles:
         """Set the (i, j) tile (reference ``local_set`` ``:954``)."""
         sl = self._slices(key)
         new = self.__arr.larray.at[sl].set(jnp.asarray(value, self.__arr.larray.dtype))
-        self.__arr.larray = self.__arr.comm.shard(new, self.__arr.split)
+        self.__arr._rebind_physical(self.__arr.comm.shard(new, self.__arr.split))
 
     # local_get/local_set alias the global accessors: every shard sees the global value
     local_get = __getitem__

@@ -500,22 +500,31 @@ class iinfo:
         return f"iinfo(dtype={self.dtype}, max={self.max}, min={self.min})"
 
 
+# module-level (not per-call lambdas): the dispatch executor caches compiled
+# programs by operation identity, and a fresh lambda per call would never hit
+def _iscomplex_value(v):
+    import jax.numpy as jnp
+
+    if jnp.iscomplexobj(v):
+        return jnp.iscomplexobj(v) & (jnp.imag(v) != 0)
+    return jnp.zeros(v.shape, jnp.bool_)
+
+
+def _isreal_value(v):
+    import jax.numpy as jnp
+
+    return jnp.isreal(v)
+
+
 def iscomplex(x):
     """Test element-wise if input is complex (reference ``types.py:766``)."""
     from . import _operations
-    import jax.numpy as jnp
 
-    return _operations.local_op(
-        lambda v: jnp.iscomplexobj(v) & (jnp.imag(v) != 0) if jnp.iscomplexobj(v) else jnp.zeros(v.shape, jnp.bool_),
-        x,
-    )
+    return _operations.local_op(_iscomplex_value, x)
 
 
 def isreal(x):
     """Test element-wise if input is real-valued (reference ``types.py:788``)."""
     from . import _operations
-    import jax.numpy as jnp
 
-    return _operations.local_op(
-        lambda v: jnp.isreal(v), x,
-    )
+    return _operations.local_op(_isreal_value, x)

@@ -22,7 +22,7 @@ def to_dense(sparse_matrix: DCSR_matrix, order: str = "C", out=None) -> DNDarray
         comm=sparse_matrix.comm,
     )
     if out is not None:
-        out.larray = out.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split)
+        out._rebind_physical(out.comm.shard(res.larray.astype(out.dtype.jax_type()), out.split))
         return out
     return res
 
