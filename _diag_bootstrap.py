@@ -1,0 +1,38 @@
+"""Shared stdlib-only diagnostics bootstrap for the driver entry points.
+
+``bench.py`` and ``__graft_entry__.py`` both need the backend-health half of
+``ht.diagnostics`` *before* anything touches the JAX backend — importing the
+``heat_tpu`` package initialises the XLA backend (the world mesh is built at
+import), which blocks forever against a dead relay. So the module is loaded BY
+FILE PATH here, once, and the ``HEAT_TPU_DIAG_LOG`` transition log is defaulted
+to ``DIAG_RELAY.jsonl`` next to this file. ``diagnostics.py`` keeps its
+top-level imports stdlib-only precisely so this works.
+"""
+
+import importlib.util
+import os
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_LOG = os.path.join(_HERE, "DIAG_RELAY.jsonl")
+
+_DIAG = None
+
+
+def load_diagnostics():
+    """The ``heat_tpu.core.diagnostics`` module as a standalone instance (one
+    per process, cached), with the diagnostics log env default applied.
+    Returns ``None`` only if the file is unloadable — callers treat health
+    recording as best-effort."""
+    global _DIAG
+    os.environ.setdefault("HEAT_TPU_DIAG_LOG", DEFAULT_LOG)
+    if _DIAG is not None:
+        return _DIAG
+    path = os.path.join(_HERE, "heat_tpu", "core", "diagnostics.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_heat_tpu_diagnostics", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception:
+        return None
+    _DIAG = mod
+    return mod

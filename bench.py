@@ -19,6 +19,66 @@ import json
 import time
 
 
+def _diagnostics():
+    """The ht.diagnostics module loaded standalone (shared loader in
+    ``_diag_bootstrap.py``, which also defaults ``HEAT_TPU_DIAG_LOG``) — never
+    via the heat_tpu package, whose import initialises the XLA backend before
+    the relay is known to be healthy. None only if the file is unloadable."""
+    import os
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    if here not in sys.path:
+        sys.path.insert(0, here)
+    import _diag_bootstrap
+
+    return _diag_bootstrap.load_diagnostics()
+
+
+# Every relay probe this round, in order: {"t", "up", "latency_s", "detail"}.
+# Transitions additionally land in the diagnostics log (HEAT_TPU_DIAG_LOG,
+# defaulted to DIAG_RELAY.jsonl next to this file) and the outage-window
+# summary is attached to the emitted JSON line as `relay_outage_windows`.
+_PROBES = []
+
+
+def _record_probe(up: bool, latency_s: float, detail: str = "") -> None:
+    import sys
+
+    rec = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "up": bool(up),
+        "latency_s": round(latency_s, 3),
+        "detail": detail,
+    }
+    _PROBES.append(rec)
+    print(json.dumps({"relay_probe": rec}), file=sys.stderr)
+    diag = _diagnostics()
+    if diag is not None:
+        diag.record_backend_event(up, detail or "bench.py relay probe")
+
+
+def _relay_outage_windows() -> list:
+    diag = _diagnostics()
+    if diag is None:
+        return []
+    return diag.relay_outage_windows(_PROBES)
+
+
+def _relay_extra() -> dict:
+    """The relay-health record for ``extra_metrics``: a numeric value (outage
+    count this round) so naive parsers chart it, with the probe history and
+    the measured windows riding along."""
+    windows = _relay_outage_windows()
+    return {
+        "metric": "relay_outage_windows",
+        "value": len(windows),
+        "unit": "windows",
+        "windows": windows,
+        "probes": list(_PROBES),
+    }
+
+
 _BF16_PEAK = {
     # per-chip bf16 matmul peak TFLOP/s by device_kind substring
     "v5 lite": 197.0,  # v5e (394 is its int8 figure)
@@ -247,24 +307,38 @@ def _bench_dispatch(devices: int = 8, timeout_s: float = 900.0) -> list:
     return records
 
 
-def _backend_reachable(timeout_s: float = 150.0, attempts: int = 3) -> bool:
-    """Probe backend initialisation in a subprocess (killable — an in-process
-    ``jax.devices()`` against a dead relay blocks in C and ignores signals).
-    Retries because the axon relay has transient outages."""
+def _probe_backend(timeout_s: float = 150.0, detail: str = "") -> bool:
+    """One killable-subprocess backend-initialisation probe (an in-process
+    ``jax.devices()`` against a dead relay blocks in C and ignores signals),
+    recorded — timestamp, result, latency — into the probe history and the
+    diagnostics backend-event stream."""
     import subprocess
     import sys
 
+    t0 = time.perf_counter()
+    up = False
+    why = "probe failed"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        up = proc.returncode == 0
+        why = "backend up" if up else f"probe rc={proc.returncode}"
+    except subprocess.TimeoutExpired:
+        why = f"probe timed out after {timeout_s:.0f}s"
+    _record_probe(up, time.perf_counter() - t0, detail or why)
+    return up
+
+
+def _backend_reachable(timeout_s: float = 150.0, attempts: int = 3) -> bool:
+    """Logged, timestamped relay-health probes (replacing the old silent retry
+    loop): each attempt is recorded via :func:`_record_probe`; retries because
+    the axon relay has transient outages."""
     for attempt in range(attempts):
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout_s,
-                capture_output=True,
-            )
-            if proc.returncode == 0:
-                return True
-        except subprocess.TimeoutExpired:
-            pass
+        if _probe_backend(timeout_s, detail=f"reachability probe {attempt + 1}/{attempts}"):
+            return True
         if attempt < attempts - 1:
             time.sleep(60)
     return False
@@ -284,6 +358,10 @@ def _emit_cached_or_null(reason: str, fail_metric: str, extras=None) -> None:
     current measurement."""
     import calendar
     import os
+
+    # this round's relay probes/windows always ride along — they are the
+    # measured evidence for WHY the on-chip number is cached or null
+    extras = (extras or []) + [_relay_extra()]
 
     if os.path.exists(_cache_path()):
         try:
@@ -312,6 +390,9 @@ def _emit_cached_or_null(reason: str, fail_metric: str, extras=None) -> None:
                         e for e in cached.get("extra_metrics", [])
                         if e.get("metric") not in fresh_names
                     ] + extras
+                # the null/cached round is attributable: the measured outage
+                # windows from this round's probes ride along
+                cached["relay_outage_windows"] = _relay_outage_windows()
                 print(json.dumps(cached))
                 return
         except Exception:
@@ -321,12 +402,18 @@ def _emit_cached_or_null(reason: str, fail_metric: str, extras=None) -> None:
         "vs_baseline": None,
         "error": f"{reason}; no fresh cached measurement from earlier in the round",
         "extra_metrics": extras or [],
+        "relay_outage_windows": _relay_outage_windows(),
     }))
 
 
 def main():
     import sys
     import traceback
+
+    # relay up/down transitions persist as JSON lines even when this process
+    # dies mid-round (doc/source/observability.rst: the diagnostics log) —
+    # loading the standalone diagnostics also applies the log-path default
+    _diagnostics()
 
     # matches the success-path name for the TPU shape so null datapoints join the series
     _FAIL_METRIC = "matmul_32768x32768_bfloat16_split0x1_tflops_per_chip"
@@ -365,6 +452,9 @@ def main():
             break
         except Exception:
             traceback.print_exc(file=sys.stderr)
+            # a failed on-chip attempt is ambiguous (real regression vs relay
+            # death mid-run): probe and record so the round's JSON can tell
+            _probe_backend(detail=f"matmul attempt {attempt + 1}/3 raised")
             if attempt < 2:
                 time.sleep(60)
     if tflops is None:
@@ -374,7 +464,8 @@ def main():
                           "unit": "TFLOP/s", "vs_baseline": None,
                           "error": "matmul benchmark failed on all 3 attempts "
                                    "(backend reachable; see stderr for tracebacks)",
-                          "extra_metrics": dispatch_extras}))
+                          "extra_metrics": dispatch_extras + [_relay_extra()],
+                          "relay_outage_windows": _relay_outage_windows()}))
         return
 
     extras = list(dispatch_extras)
@@ -408,12 +499,14 @@ def main():
 
     # vs_baseline = fraction of the chip's bf16 matmul peak; CPU: no target
     peak = _peak_tflops(jax) if on_tpu else max(tflops, 1e-9)
+    extras.append(_relay_extra())
     record = {
         "metric": f"matmul_{n}x{n}_{dtype_name}_split0x1_tflops_per_chip",
         "value": round(tflops, 3),
         "unit": "TFLOP/s",
         "vs_baseline": round(tflops / peak, 4),
         "extra_metrics": extras,
+        "relay_outage_windows": _relay_outage_windows(),
     }
     if on_tpu:
         # persist so a later relay outage can still report this round's numbers

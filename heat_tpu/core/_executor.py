@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
@@ -57,6 +58,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from . import diagnostics
 
 __all__ = [
     "executor_stats",
@@ -107,47 +110,168 @@ def jit_threshold() -> int:
         return 1
 
 
+_single_controller: Optional[bool] = None
+
+
 def executor_enabled() -> bool:
     """Whether dispatch should route through the cached-program executor.
 
     ``HEAT_TPU_EAGER_DISPATCH=1`` is the debugging escape hatch (read per call so
     tests can flip it); multi-controller processes always take the eager path —
     its ``comm.shard`` has the per-process shard-population logic the staged
-    programs do not replicate."""
+    programs do not replicate. The process count is resolved once (it cannot
+    change after backend initialisation, and dispatch calls this per op —
+    twice for binary ops — so the xla_bridge round-trip matters)."""
+    global _single_controller
     if os.environ.get("HEAT_TPU_EAGER_DISPATCH") == "1":
         return False
-    return jax.process_count() == 1
+    if _single_controller is None:
+        _single_controller = jax.process_count() == 1
+    return _single_controller
 
 
-def executor_stats() -> dict:
+def executor_stats(top: int = 0) -> dict:
     """Cache introspection: ``hits`` / ``misses`` (signature-table lookups),
     ``retraces`` (times a program body was actually traced — 0 between two
     identical calls means the replay was pure cache), and ``programs`` (table
-    size, unsupported-signature entries included)."""
-    return {
+    size, unsupported-signature entries included).
+
+    ``top > 0`` adds ``top_signatures``: the N hottest compiled programs by
+    lifetime replay count, each as ``{"label", "hits", "compile_s"}`` —
+    ``label`` names the dispatch family and operation (``"defer:add..add[64]"``,
+    ``"r:sum"``), ``hits`` counts replays since the program was compiled (NOT
+    reset by :func:`reset_executor_stats` — they live with the program), and
+    ``compile_s`` is the first-call wall time (trace + XLA compile + first
+    execution)."""
+    stats = {
         "hits": _stats.hits,
         "misses": _stats.misses,
         "retraces": _stats.retraces,
         "programs": len(_programs),
     }
+    if top > 0:
+        with _lock:
+            progs = [
+                (key, entry)
+                for key, entry in _programs.items()
+                if entry is not UNSUPPORTED
+            ]
+        progs.sort(key=lambda item: item[1].hits, reverse=True)
+        stats["top_signatures"] = [
+            {
+                "label": entry.label or _key_label(key),
+                "hits": entry.hits,
+                "compile_s": round(entry.compile_s, 6),
+            }
+            for key, entry in progs[:top]
+        ]
+    return stats
 
 
 def reset_executor_stats() -> None:
-    """Zero the counters (the program table is kept — see
-    :func:`clear_executor_cache`)."""
+    """Zero the GLOBAL counters (``hits`` / ``misses`` / ``retraces``). The
+    program table is kept, and so are the per-signature lifetime tallies behind
+    ``executor_stats(top=N)`` — those are properties of the cached programs and
+    only drop with them (:func:`clear_executor_cache`)."""
     _stats.hits = 0
     _stats.misses = 0
     _stats.retraces = 0
 
 
 def clear_executor_cache() -> None:
-    """Drop every cached program (plus warm-up counts and result-aval cache),
-    zero the counters."""
+    """Drop every cached program (plus warm-up counts and result-aval cache)
+    AND reset all statistics: the global ``hits`` / ``misses`` / ``retraces``
+    counters are zeroed, and the per-signature breakdown of
+    ``executor_stats(top=N)`` empties because the programs carrying those
+    tallies are gone. After this call ``executor_stats()`` reports all zeros
+    and the next dispatch of any signature recompiles (a counted retrace)."""
     with _lock:
         _programs.clear()
         _seen.clear()
         _aval_cache.clear()
     reset_executor_stats()
+
+
+# ------------------------------------------------------------------ diagnostics glue
+# Signature keys are positional tuples; these name the positions per dispatch
+# family so a cache miss can be *explained* — which component changed vs. the
+# nearest cached key (diagnostics.record_dispatch_event). Keys are built in
+# _operations (b.pad/b.log/l/r/c) and _force below (defer).
+_KEY_COMPONENTS: Dict[str, Tuple[str, ...]] = {
+    "b.pad": ("family", "operation", "kwargs", "out_shape", "out_split", "mesh",
+              "operand_avals"),
+    "b.log": ("family", "operation", "kwargs", "out_shape", "out_split", "mesh",
+              "operand_avals", "where", "out"),
+    "l": ("family", "operation", "kwargs", "operand_aval", "gshape", "split",
+          "mesh", "out"),
+    "r": ("family", "operation", "kwargs", "operand_aval", "gshape", "split",
+          "axis", "keepdims", "mesh", "out"),
+    "c": ("family", "operation", "kwargs", "operand_aval", "gshape", "split",
+          "axis", "accum_dtype", "mesh", "out"),
+    "defer": ("family", "mesh", "gshape", "split", "graph"),
+}
+
+
+def _op_label(operation) -> str:
+    name = getattr(operation, "__name__", None)
+    return name if name else repr(operation)
+
+
+def _key_label(key) -> str:
+    """A compact human label for a signature key: dispatch family + op name
+    (``"r:sum"``), or first/last node and length for a fused graph
+    (``"defer:add..mul[64]"``)."""
+    if not isinstance(key, tuple) or not key:
+        return repr(key)
+    tag = key[0]
+    if tag == "defer" and len(key) >= 5 and isinstance(key[4], tuple) and key[4]:
+        ops = [_op_label(entry[0]) for entry in key[4]]
+        return f"defer:{ops[0]}..{ops[-1]}[{len(ops)}]"
+    if tag in _KEY_COMPONENTS and len(key) >= 2:
+        return f"{tag}:{_op_label(key[1])}"
+    return repr(tag)
+
+
+def _miss_reason(key) -> str:
+    """Explain a cache miss: diff ``key`` against the nearest cached key of the
+    same dispatch family and name the signature component(s) that changed.
+    Only called when diagnostics are enabled (it scans the table)."""
+    if not isinstance(key, tuple) or not key:
+        return "uncategorised signature"
+    n = _seen.get(key)
+    if n is not None:
+        # the signature is known but still warming up (jit threshold > 1):
+        # the repeat count, not a key diff, is the whole explanation
+        return f"warm-up (seen {n + 1} of threshold {jit_threshold()})"
+    tag = key[0]
+    names = _KEY_COMPONENTS.get(tag)
+    best_diff: Optional[Tuple[int, ...]] = None
+    # newest-first, bounded: the nearest key is almost always a recent one, and
+    # a miss-dominated workload (the test suite's profile) must not pay a full
+    # 1024-key × deep-tuple comparison under _lock per miss — the cap bounds
+    # the WALK itself, not just the same-family comparisons
+    scanned = 0
+    for cached in reversed(_programs):
+        scanned += 1
+        if scanned > 256:
+            break
+        if not isinstance(cached, tuple) or len(cached) != len(key) or cached[0] != tag:
+            continue
+        diff = tuple(i for i in range(1, len(key)) if cached[i] != key[i])
+        if best_diff is None or len(diff) < len(best_diff):
+            best_diff = diff
+            if len(diff) <= 1:
+                break
+    if best_diff is None:
+        return f"first {tag!r} signature seen"
+    if not best_diff:
+        return "evicted signature recompiled"  # identical key no longer cached
+    if names:
+        changed = ", ".join(names[i] if i < len(names) else f"component[{i}]"
+                            for i in best_diff)
+    else:
+        changed = ", ".join(f"component[{i}]" for i in best_diff)
+    return f"changed vs nearest cached signature: {changed}"
 
 
 def kwargs_sig(kwargs: dict):
@@ -196,49 +320,94 @@ class _Program:
     ``donate_index`` names the trailing ``out=`` buffer argument; the donating
     and non-donating variants are jitted lazily because donation safety is a
     per-call property of the destination buffer (see
-    ``sanitation.sanitize_donation``), not of the signature."""
+    ``sanitation.sanitize_donation``), not of the signature.
 
-    __slots__ = ("body", "out_shardings", "donate_index", "meta", "_plain", "_donating")
+    Telemetry carried per program (all first-call or per-hit trivia — nothing
+    on the replay hot path beyond an integer increment in :func:`lookup`):
+    ``label`` (human signature name), ``hits`` (lifetime replays), ``compile_s``
+    (first-call wall time per jit variant, summed), ``arg_specs`` (the abstract
+    argument signature of the first call — lets tests and tools re-lower the
+    exact executable for HLO inspection)."""
+
+    __slots__ = (
+        "body", "out_shardings", "donate_index", "meta",
+        "label", "hits", "compile_s", "arg_specs", "_plain", "_donating",
+    )
 
     def __init__(self, body, out_shardings, donate_index, meta):
         self.body = body
         self.out_shardings = out_shardings
         self.donate_index = donate_index
         self.meta = meta
+        self.label = None
+        self.hits = 0
+        self.compile_s = 0.0
+        self.arg_specs = None
         self._plain = None
         self._donating = None
 
     def _traced(self):
         body = self.body
+        label = self.label
 
         def counted(*args):
             _stats.retraces += 1
+            if diagnostics._tracing:
+                # trace-time gate: framework-level op names compiled into HLO
+                # metadata (device traces show them); OFF injects nothing, so
+                # the executable is byte-identical to an uninstrumented build
+                with jax.named_scope(f"ht.{label or 'dispatch'}"):
+                    return body(*args)
             return body(*args)
 
         return counted
 
     def __call__(self, *args, donate: bool = False):
-        if donate and self.donate_index is not None:
-            fn = self._donating
-            if fn is None:
-                # keep_unused: a plain out= overwrite never reads the destination
-                # buffer, and jit would otherwise prune the argument and lose the
-                # input/output aliasing the donation exists for
-                fn = self._donating = jax.jit(
-                    self._traced(),
-                    out_shardings=self.out_shardings,
-                    donate_argnums=(self.donate_index,),
-                    keep_unused=True,
-                )
-            return fn(*args)
-        fn = self._plain
-        if fn is None:
-            fn = self._plain = jax.jit(
-                self._traced(),
-                out_shardings=self.out_shardings,
-                keep_unused=self.donate_index is not None,
-            )
-        return fn(*args)
+        donating = donate and self.donate_index is not None
+        fn = self._donating if donating else self._plain
+        first = fn is None
+        if first:
+            # build the jit variant under the executor lock: two threads racing
+            # the first call of one program must share ONE jit object (else both
+            # trace — double-counted retraces/compile events, wasted compile)
+            with _lock:
+                fn = self._donating if donating else self._plain
+                first = fn is None
+                if first and donating:
+                    # keep_unused: a plain out= overwrite never reads the
+                    # destination buffer, and jit would otherwise prune the
+                    # argument and lose the input/output aliasing the donation
+                    # exists for
+                    fn = self._donating = jax.jit(
+                        self._traced(),
+                        out_shardings=self.out_shardings,
+                        donate_argnums=(self.donate_index,),
+                        keep_unused=True,
+                    )
+                elif first:
+                    fn = self._plain = jax.jit(
+                        self._traced(),
+                        out_shardings=self.out_shardings,
+                        keep_unused=self.donate_index is not None,
+                    )
+                if self.arg_specs is None:
+                    self.arg_specs = tuple(
+                        jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        if isinstance(a, jax.Array) else a
+                        for a in args
+                    )
+            t0 = time.perf_counter()
+        if diagnostics._tracing:
+            with jax.profiler.TraceAnnotation(f"ht.dispatch:{self.label or 'program'}"):
+                out = fn(*args)
+        else:
+            out = fn(*args)
+        if first:
+            dt = time.perf_counter() - t0
+            self.compile_s += dt
+            if diagnostics._enabled:
+                diagnostics.record_compile(self.label or "program", dt)
+        return out
 
 
 def lookup(key, build: Callable[[], Any]) -> Optional[_Program]:
@@ -255,8 +424,14 @@ def lookup(key, build: Callable[[], Any]) -> Optional[_Program]:
         entry = _programs.get(key)
         if entry is not None:
             _stats.hits += 1
+            if entry is not UNSUPPORTED:
+                entry.hits += 1  # lifetime per-signature tally (executor_stats top=N)
             _programs.move_to_end(key)  # eviction is LRU, not FIFO: hits refresh
             return None if entry is UNSUPPORTED else entry
+        if diagnostics._enabled:
+            # explain the miss BEFORE the table mutates: which signature
+            # component changed vs. the nearest cached key of the same family
+            diagnostics.record_dispatch_event("miss", _key_label(key), _miss_reason(key))
         threshold = jit_threshold()
         if threshold > 1:
             n = _seen.get(key, 0) + 1
@@ -264,7 +439,13 @@ def lookup(key, build: Callable[[], Any]) -> Optional[_Program]:
                 # still warming up: the caller takes the eager path; only a
                 # signature seen `threshold` times earns a compile
                 if len(_seen) >= _MAX_SEEN:
-                    _seen.clear()
+                    # evict the least-recently-SEEN half, not everything: a hot
+                    # signature one sighting from its compile must not restart
+                    # at zero every time a signature-churning workload fills
+                    # the table (the pop below keeps re-seen keys at the end)
+                    for stale in list(_seen)[: _MAX_SEEN // 2]:
+                        del _seen[stale]
+                _seen.pop(key, None)  # re-insert at the end: recency order
                 _seen[key] = n
                 _stats.misses += 1
                 return None
@@ -274,6 +455,7 @@ def lookup(key, build: Callable[[], Any]) -> Optional[_Program]:
             entry = UNSUPPORTED
         else:
             entry = _Program(*built)
+            entry.label = _key_label(key)
         while len(_programs) >= _MAX_PROGRAMS:
             _programs.popitem(last=False)
         _programs[key] = entry
@@ -467,6 +649,8 @@ def _force(root: Deferred):
     visit(root)
     gshape, split = root.gshape, root.split
     padded = tuple(root.shape) != gshape
+    if padded and diagnostics._enabled:
+        diagnostics.record_pad_waste(gshape, split, root.shape[split])
     key = (
         "defer", root.comm.mesh, gshape, split,
         tuple((op_sig(op), kwargs_sig(kw), refs) for op, kw, refs in entries),
@@ -502,3 +686,9 @@ def _force(root: Deferred):
             result = _zero_pads(result, gshape, split)
         return root.comm.shard(result, split)
     return prog(*leaves)
+
+
+# The executor's section of ht.diagnostics.report(): global counters plus the
+# ten hottest signatures (registered as a provider so diagnostics stays
+# standalone-loadable — no import cycle).
+diagnostics.register_provider("executor", lambda: executor_stats(top=10))

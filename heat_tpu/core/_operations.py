@@ -25,7 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import _executor, sanitation, types
+from . import _executor, diagnostics, sanitation, types
 from .communication import get_comm
 from .devices import get_device
 from .dndarray import DNDarray
@@ -54,6 +54,15 @@ Scalar = (int, float, bool, complex, np.number, np.bool_)
 # circular import); re-exported here for the wrappers and their tests
 _pad_mask = _executor._pad_mask
 _zero_pads = _executor._zero_pads
+
+
+def _note_pad_waste(gshape, split: Optional[int], comm) -> None:
+    """Gauge the padded-layout waste of the ``(gshape, split)`` family this
+    dispatch touched (ht.diagnostics pad_waste). Callers gate on
+    ``diagnostics._enabled`` so the disabled cost is one attribute read."""
+    if split is None:
+        return
+    diagnostics.record_pad_waste(gshape, split, comm.padded_dim(gshape[split]))
 
 
 def _is_complexish(*ts) -> bool:
@@ -322,6 +331,8 @@ def _binary_jit(
             if prog is None:
                 return NotImplemented
             value = prog(*phys)
+            if diagnostics._enabled:
+                _note_pad_waste(out_shape, out_split, comm)
             return DNDarray(
                 value, tuple(out_shape), types.canonical_heat_type(value.dtype),
                 out_split, device or get_device(), comm, True,
@@ -400,6 +411,8 @@ def _binary_jit(
     prog = _executor.lookup(key, build)
     if prog is None:
         return NotImplemented
+    if diagnostics._enabled and phys_shape != tuple(out_shape):
+        _note_pad_waste(out_shape, out_split, comm)
     if has_out:
         value = prog(*vals, out.parray, donate=donate)
         out._rebind_physical(value)
@@ -489,6 +502,8 @@ def _local_jit(operation, x, out, fn_kwargs):
     prog = _executor.lookup(key, build)
     if prog is None:
         return NotImplemented
+    if diagnostics._enabled and x_padded:
+        _note_pad_waste(gshape, split, comm)
     kind, rshape, rsplit = prog.meta
     if kind == "out":
         sanitation.sanitize_out(out, gshape, split, x.device)
@@ -595,6 +610,8 @@ def _reduce_jit(operation, x, axis, out_split, out, keepdims, fn_kwargs):
     prog = _executor.lookup(key, build)
     if prog is None:
         return NotImplemented
+    if diagnostics._enabled and x_padded:
+        _note_pad_waste(gshape, split, comm)
     kind, rshape, fsplit = prog.meta
     if kind == "out":
         sanitation.sanitize_out(out, rshape, fsplit, x.device)
@@ -673,6 +690,8 @@ def _cum_jit(operation, x, axis, out, target, fn_kwargs):
     prog = _executor.lookup(key, build)
     if prog is None:
         return NotImplemented
+    if diagnostics._enabled and x_padded:
+        _note_pad_waste(gshape, split, comm)
     if prog.meta == ("out",):
         sanitation.sanitize_out(out, gshape, split, x.device)
         donate = sanitation.sanitize_donation(out, [xval])
@@ -747,6 +766,8 @@ def binary_op(
     ):
         phys = _padded_physical_operands(((t1, a), (t2, b)), out_shape, out_split, use_comm)
         if phys is not None:
+            if diagnostics._enabled:
+                _note_pad_waste(out_shape, out_split, use_comm)
             result = operation(phys[0], phys[1], **fn_kwargs)
             result = _zero_pads(result, out_shape, out_split)
             result = use_comm.shard(result, out_split)
@@ -816,6 +837,8 @@ def local_op(
         if tuple(result.shape) == tuple(x.parray.shape) and not jnp.issubdtype(
             result.dtype, jnp.complexfloating
         ):
+            if diagnostics._enabled:
+                _note_pad_waste(x.gshape, x.split, x.comm)
             result = _zero_pads(result, x.gshape, x.split)
             result = x.comm.shard(result, x.split)
             return DNDarray(
@@ -830,7 +853,7 @@ def local_op(
     result = operation(x.larray, **fn_kwargs)
     if out is not None:
         sanitation.sanitize_out(out, x.gshape, x.split, x.device)
-        out.larray = x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)
+        out._rebind_physical(x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split))
         return out
     gshape = tuple(result.shape)
     result = x.comm.shard(result, x.split)
@@ -949,6 +972,8 @@ def _padded_reduce(operation, x: DNDarray, axis, out_split, keepdims, fn_kwargs)
     )
     if r is None:
         return None
+    if diagnostics._enabled:
+        _note_pad_waste(x.gshape, x.split, x.comm)
     result, out_shape, final_split = r
     result = x.comm.shard(result, final_split)
     return DNDarray(
@@ -988,7 +1013,7 @@ def reduce_op(
         out_split = None
     if out is not None:
         sanitation.sanitize_out(out, out_shape, out_split, x.device)
-        out.larray = x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)
+        out._rebind_physical(x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split))
         return out
     result = x.comm.shard(result, out_split)
     return DNDarray(
@@ -1022,6 +1047,8 @@ def cum_op(
     ):
         # ragged fast path: layout padding sits at the END of the global split dim, so
         # a prefix op along any axis never reads pad slots before logical ones
+        if diagnostics._enabled:
+            _note_pad_waste(x.gshape, x.split, x.comm)
         value = x.parray if target is None else _safe_astype(x.parray, target)
         result = operation(value, axis=axis, **fn_kwargs)
         result = _zero_pads(result, x.gshape, x.split)
@@ -1038,7 +1065,7 @@ def cum_op(
     result = operation(value, axis=axis, **fn_kwargs)
     if out is not None:
         sanitation.sanitize_out(out, x.gshape, x.split, x.device)
-        out.larray = x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split)
+        out._rebind_physical(x.comm.shard(_safe_astype(result, out.dtype.jax_type()), out.split))
         return out
     result = x.comm.shard(result, x.split)
     return DNDarray(

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from . import diagnostics
+
 # Multi-controller bootstrap must run BEFORE anything touches the XLA backend —
 # and importing heat_tpu itself does (the COMM_WORLD mesh below calls
 # jax.devices()). The launcher therefore passes the coordination parameters by
@@ -79,6 +81,20 @@ __all__ = [
 
 # The default mesh axis name carried by every split DNDarray dimension.
 MESH_AXIS = "d"
+
+
+def _payload_bytes(x) -> int:
+    """Per-participant payload bytes of a collective operand — works on concrete
+    arrays AND tracers (collectives run inside shard_map/jit traces, so the
+    diagnostics hooks see abstract values; shape/dtype are always static)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return int(np.dtype(type(x)).itemsize) if np.isscalar(x) else 0
+    size = 1
+    for s in shape:
+        size *= int(s)
+    return size * np.dtype(dtype).itemsize
 
 
 class Communication:
@@ -308,6 +324,14 @@ class MeshCommunication(Communication):
                 # un-sharded — on such systems the accelerator mesh is the wrong home
                 # for this dtype and the split is metadata only
                 return jax.device_put(array, cpu_fallback_device())
+        if diagnostics._enabled:
+            # counts every layout REQUEST with its logical payload: an operand
+            # that already matches the target (the early return below) costs no
+            # device movement but is still one counted shard call — the counter
+            # tracks the framework's layout traffic, not XLA's wire bytes
+            diagnostics.record_collective(
+                "shard", self.axis_name, self.size, _payload_bytes(array)
+            )
         target = self.sharding(array.ndim, split)
         if isinstance(array, jax.Array):
             try:
@@ -343,27 +367,63 @@ class MeshCommunication(Communication):
     # Functional collectives usable inside shard_map blocks. Names kept close to the
     # reference's MPI surface (communication.py:541-1996) for discoverability, but these
     # are *pure functions of device-local values*, not buffer mutations.
+    #
+    # Every collective reports (op, mesh axis, participants, logical bytes) to
+    # ht.diagnostics when metrics are enabled. The hooks run at Python call time —
+    # inside a shard_map/jit trace that is TRACE time, so a cached executable's
+    # replays are not re-counted (documented in doc/source/observability.rst).
+    # Nested convenience forms count both layers (scan also records its inner
+    # exscan, scatter its inner broadcast).
+    def _axis_participants(self, axis_name=None) -> int:
+        """Static shard count of the (possibly tuple-valued) named axis."""
+        name = axis_name or self.axis_name
+        names = (name,) if isinstance(name, str) else tuple(name)
+        try:
+            return int(np.prod([self.mesh.shape[n] for n in names]))
+        except (KeyError, TypeError):
+            return self.size
+
+    def _record_collective(self, op: str, axis_name, x) -> None:
+        """Report one collective to ht.diagnostics: logical bytes = per-participant
+        payload × participants. Callers gate on ``diagnostics._enabled`` so the
+        disabled cost is one attribute read."""
+        participants = self._axis_participants(axis_name)
+        diagnostics.record_collective(
+            op, axis_name or self.axis_name, participants,
+            _payload_bytes(x) * participants,
+        )
+
     def psum(self, x, axis_name: Optional[str] = None):
+        if diagnostics._enabled:
+            self._record_collective("psum", axis_name, x)
         return jax.lax.psum(x, axis_name or self.axis_name)
 
     Allreduce = psum
 
     def pmax(self, x, axis_name: Optional[str] = None):
+        if diagnostics._enabled:
+            self._record_collective("pmax", axis_name, x)
         return jax.lax.pmax(x, axis_name or self.axis_name)
 
     def pmin(self, x, axis_name: Optional[str] = None):
+        if diagnostics._enabled:
+            self._record_collective("pmin", axis_name, x)
         return jax.lax.pmin(x, axis_name or self.axis_name)
 
     def all_gather(self, x, axis: int = 0, axis_name: Optional[str] = None, tiled: bool = True):
         """Allgather along array axis ``axis`` (reference ``__allgather_like``
         ``communication.py:1047-1128``; the axis-permutation machinery there is subsumed
         by ``jax.lax.all_gather(axis=...)``)."""
+        if diagnostics._enabled:
+            self._record_collective("all_gather", axis_name, x)
         return jax.lax.all_gather(x, axis_name or self.axis_name, axis=axis, tiled=tiled)
 
     Allgather = all_gather
 
     def all_to_all(self, x, split_axis: int, concat_axis: int, axis_name: Optional[str] = None):
         """Alltoall (reference ``__alltoall_like`` ``communication.py:1236``)."""
+        if diagnostics._enabled:
+            self._record_collective("all_to_all", axis_name, x)
         return jax.lax.all_to_all(
             x, axis_name or self.axis_name, split_axis=split_axis, concat_axis=concat_axis,
             tiled=True,
@@ -373,11 +433,15 @@ class MeshCommunication(Communication):
 
     def ppermute(self, x, perm, axis_name: Optional[str] = None):
         """Point-to-point send/recv pattern (reference Send/Recv ``communication.py:541-707``)."""
+        if diagnostics._enabled:
+            self._record_collective("ppermute", axis_name, x)
         return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
 
     def ring_shift(self, x, shift: int = 1, axis_name: Optional[str] = None):
         """Rotate shards around the ring — the TPU form of the reference's ring algorithms
         (``spatial/distance.py:209``)."""
+        if diagnostics._enabled:
+            self._record_collective("ring_shift", axis_name, x)
         n = self.size
         perm = [(i, (i + shift) % n) for i in range(n)]
         return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
@@ -391,6 +455,8 @@ class MeshCommunication(Communication):
         latency win at pod scale.) Multi-axis communicators keep the psum form,
         whose all-axis reduction is what their semantics need.
         """
+        if diagnostics._enabled:
+            self._record_collective("broadcast", axis_name, x)
         name = axis_name or self.axis_name
         if not isinstance(name, str):
             idx = jax.lax.axis_index(name)
@@ -423,6 +489,8 @@ class MeshCommunication(Communication):
         form whose per-device payload is P×. Works for any P (not just powers of
         two); shard 0 receives the additive identity.
         """
+        if diagnostics._enabled:
+            self._record_collective("exscan", axis_name, x)
         name = axis_name or self.axis_name
         if not isinstance(name, str):
             idx = jax.lax.axis_index(name)
@@ -443,6 +511,8 @@ class MeshCommunication(Communication):
     def scan(self, x, axis_name: Optional[str] = None):
         """Inclusive prefix-sum over shards (reference Scan ``communication.py:1881``):
         the exclusive scan plus the local contribution."""
+        if diagnostics._enabled:
+            self._record_collective("scan", axis_name, x)
         return self.exscan(x, axis_name) + x
 
     Scan = scan
@@ -452,6 +522,8 @@ class MeshCommunication(Communication):
         Reduce ``communication.py:1823``): SPMD collectives are symmetric, so this
         is the all-reduce with non-root shards zeroed — the rooted contract without
         a second collective."""
+        if diagnostics._enabled:
+            self._record_collective("reduce", axis_name, x)
         name = axis_name or self.axis_name
         total = jax.lax.psum(x, name)
         idx = jax.lax.axis_index(name)
@@ -463,6 +535,8 @@ class MeshCommunication(Communication):
         """Gather shards to ``root`` (reference Gather ``communication.py:1299``):
         the all-gather with non-root shards zeroed — rooted semantics on a
         symmetric collective."""
+        if diagnostics._enabled:
+            self._record_collective("gather", axis_name, x)
         name = axis_name or self.axis_name
         full = jax.lax.all_gather(x, name, axis=axis, tiled=True)
         idx = jax.lax.axis_index(name)
@@ -477,6 +551,8 @@ class MeshCommunication(Communication):
         the wire cost is the broadcast's P−1 full payloads rather than MPI's 1/P
         chunks — acceptable because every framework path that needs 1/P placement
         uses shardings (``comm.shard``), not this rooted op."""
+        if diagnostics._enabled:
+            self._record_collective("scatter", axis_name, x)
         name = axis_name or self.axis_name
         full = self.broadcast(x, root=root, axis_name=name)
         idx = jax.lax.axis_index(name)
@@ -531,6 +607,11 @@ def _pad_reshard(
     """Reshard a (possibly non-addressable) global array, zero-padding a ragged split
     dimension to ``padded`` inside the jitted program so the output satisfies a true
     1/P NamedSharding."""
+    if diagnostics._enabled:
+        diagnostics.record_collective(
+            "_pad_reshard", target.mesh.axis_names, target.mesh.size,
+            _payload_bytes(array),
+        )
     key = (target, array.ndim, split, padded)  # NamedSharding hashes mesh + devices,
     # so two same-shape meshes over different device sets cannot collide
     fn = _pad_cache.get(key)

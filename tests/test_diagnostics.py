@@ -1,0 +1,439 @@
+"""``ht.diagnostics`` tests (ISSUE 4 tentpole).
+
+Four groups, mirroring the subsystem's contract
+(``heat_tpu/core/diagnostics.py``):
+
+- report plumbing: enable/disable/reset/report/dump, span aggregation, the
+  ``HEAT_TPU_METRICS=1`` env knob honored at import (subprocess);
+- enabled-mode accounting against HAND-COUNTED ground truth: a 64-op deferred
+  chain is exactly ONE compile event, a split=0 matmul is exactly one ``shard``
+  record with its logical byte count, a ragged-extent mean leaves a pad-waste
+  gauge, and a ``shard_map`` ``psum`` records payload × participants bytes;
+- backend-health stream: transitions-only recording, JSONL persistence via
+  ``HEAT_TPU_DIAG_LOG``, outage-window folding;
+- the zero-overhead-when-off contract: the compiled HLO of an
+  instrumented-but-disabled ``(x + y).sum()`` chain is byte-identical across
+  disable → enable(trace) → disable round trips — the disabled executable
+  contains nothing the pre-diagnostics one did not.
+"""
+
+import contextlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import _executor, diagnostics
+from heat_tpu.testing import TestCase
+
+_OLD_THRESHOLD = None
+
+
+def setUpModule():
+    # compile-on-first-miss (the production default) so compile-event counts
+    # are deterministic; the suite conftest raises the warm-up threshold
+    global _OLD_THRESHOLD
+    _OLD_THRESHOLD = os.environ.get("HEAT_TPU_JIT_THRESHOLD")
+    os.environ["HEAT_TPU_JIT_THRESHOLD"] = "1"
+
+
+def tearDownModule():
+    if _OLD_THRESHOLD is None:
+        os.environ.pop("HEAT_TPU_JIT_THRESHOLD", None)
+    else:
+        os.environ["HEAT_TPU_JIT_THRESHOLD"] = _OLD_THRESHOLD
+
+
+@contextlib.contextmanager
+def metrics(trace=None):
+    """Enable diagnostics for a block, restoring the prior switch state."""
+    was_enabled, was_tracing = diagnostics.enabled(), diagnostics.tracing()
+    diagnostics.enable(trace=trace)
+    try:
+        yield
+    finally:
+        diagnostics.reset()
+        if was_enabled:
+            diagnostics.enable(trace=was_tracing)
+        else:
+            diagnostics.disable(trace=was_tracing)
+
+
+@contextlib.contextmanager
+def eager_dispatch():
+    old = os.environ.get("HEAT_TPU_EAGER_DISPATCH")
+    os.environ["HEAT_TPU_EAGER_DISPATCH"] = "1"
+    try:
+        yield
+    finally:
+        if old is None:
+            del os.environ["HEAT_TPU_EAGER_DISPATCH"]
+        else:
+            os.environ["HEAT_TPU_EAGER_DISPATCH"] = old
+
+
+def _chain64(x, y):
+    for _ in range(16):
+        x = x + y
+        x = x * 0.5
+        x = x - y
+        x = x + 1.0
+    return x
+
+
+class _DiagTestCase(TestCase):
+    """Save/restore the global diagnostics switches around every test, so a
+    suite-wide HEAT_TPU_METRICS=1 run (the CI artifact) keeps COLLECTING after
+    this module. (The hand-count tests still reset() the shared registry, so
+    the artifact holds the post-test_diagnostics tail of the run plus the
+    executor's lifetime per-signature tallies — documented in ci.yaml.)"""
+
+    def setUp(self):
+        super().setUp()
+        self._was_enabled = diagnostics.enabled()
+        self._was_tracing = diagnostics.tracing()
+
+    def tearDown(self):
+        diagnostics.reset()
+        if self._was_enabled:
+            diagnostics.enable(trace=self._was_tracing)
+        else:
+            diagnostics.disable(trace=self._was_tracing)
+        super().tearDown()
+
+
+class TestReportPlumbing(_DiagTestCase):
+    def test_top_level_namespace(self):
+        for name in ("enable", "disable", "report", "dump", "span", "reset"):
+            self.assertTrue(hasattr(ht.diagnostics, name))
+
+    def test_disabled_records_nothing(self):
+        diagnostics.disable()
+        diagnostics.reset()
+        a = ht.array(np.arange(13, dtype=np.float32), split=0)
+        (a + 1.0).parray
+        ht.mean(a).parray
+        rep = diagnostics.report()
+        self.assertFalse(rep["enabled"])
+        self.assertEqual(rep["collectives"], [])
+        self.assertEqual(rep["pad_waste"], [])
+        self.assertEqual(rep["compile_events"], [])
+        self.assertEqual(rep["counters"], {})
+
+    def test_span_and_counter_aggregation(self):
+        with metrics():
+            diagnostics.reset()
+            for _ in range(3):
+                with diagnostics.span("unit-test-span"):
+                    pass
+            diagnostics.counter("unit-test-counter", 2)
+            diagnostics.counter("unit-test-counter")
+            rep = diagnostics.report()
+        span = rep["spans"]["unit-test-span"]
+        self.assertEqual(span["count"], 3)
+        self.assertGreaterEqual(span["total_s"], 0.0)
+        self.assertGreaterEqual(span["max_s"], 0.0)
+        self.assertEqual(rep["counters"]["unit-test-counter"], 3)
+
+    def test_dump_writes_schema_json(self):
+        with metrics():
+            with tempfile.TemporaryDirectory() as d:
+                path = os.path.join(d, "diag.json")
+                diagnostics.dump(path)
+                with open(path) as f:
+                    rep = json.load(f)
+        self.assertEqual(rep["schema"], diagnostics.SCHEMA)
+        self.assertIn("executor", rep)
+        self.assertIn("relay_outage_windows", rep)
+
+    def test_env_knob_enables_at_import(self):
+        # HEAT_TPU_METRICS=1 must take effect at import with no enable() call;
+        # exercised in a subprocess because the env is read once at module load
+        code = (
+            "import heat_tpu as ht\n"
+            "assert ht.diagnostics.enabled()\n"
+            "assert not ht.diagnostics.tracing()\n"
+            "import numpy as np\n"
+            "x = ht.array(np.arange(13, dtype=np.float32), split=0)\n"
+            "(x + 1.0).parray\n"
+            "rep = ht.diagnostics.report()\n"
+            "assert rep['enabled'] and rep['collectives'], rep['collectives']\n"
+            "print('env-knob-ok')\n"
+        )
+        env = dict(os.environ)
+        env["HEAT_TPU_METRICS"] = "1"
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+            timeout=240,
+        )
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        self.assertIn("env-knob-ok", proc.stdout)
+
+
+class TestHandCountedTelemetry(_DiagTestCase):
+    """Enabled-mode counters must match collectives counted by reading the
+    implementation — observability that cannot be trusted is noise."""
+
+    def test_deferred_chain_is_one_compile_event(self):
+        # 64 framework-level ops forced via .parray = ONE program = ONE compile
+        np_x = np.arange(13, dtype=np.float32)
+        np_y = np.ones(13, dtype=np.float32)
+        x = ht.array(np_x, split=0)
+        y = ht.array(np_y, split=0)
+        _executor.clear_executor_cache()
+        with metrics():
+            diagnostics.reset()
+            out = _chain64(x, y)
+            out.parray
+            rep = diagnostics.report()
+        self.assertEqual(len(rep["compile_events"]), 1, rep["compile_events"])
+        label = rep["compile_events"][0]["label"]
+        self.assertTrue(label.startswith("defer:"), label)
+        self.assertIn("[64]", label)
+        self.assertGreater(rep["compile_events"][0]["seconds"], 0.0)
+        # the ragged (13,) split-0 family leaves its pad-waste gauge
+        self.assertTrue(
+            any(g["gshape"] == [13] and g["split"] == 0 for g in rep["pad_waste"]),
+            rep["pad_waste"],
+        )
+        # the miss is explained
+        misses = [e for e in rep["dispatch_events"] if e["kind"] == "miss"]
+        self.assertEqual(len(misses), 1)
+        self.assertTrue(misses[0]["reason"])
+
+    def test_matmul_split0_shard_bytes(self):
+        # split=0 matmul: exactly ONE layout collective — _wrap_like lays the
+        # (8, 8) float32 product out over the mesh = 8*8*4 = 256 logical bytes
+        np_a = np.random.default_rng(0).standard_normal((8, 8)).astype(np.float32)
+        np_b = np.random.default_rng(1).standard_normal((8, 8)).astype(np.float32)
+        a = ht.array(np_a, split=0)
+        b = ht.array(np_b, split=None)
+        with metrics():
+            diagnostics.reset()
+            ht.linalg.matmul(a, b)
+            rep = diagnostics.report()
+        self.assertEqual(len(rep["collectives"]), 1, rep["collectives"])
+        rec = rep["collectives"][0]
+        self.assertEqual(rec["op"], "shard")
+        self.assertEqual(rec["count"], 1)
+        self.assertEqual(rec["bytes"], 8 * 8 * 4)
+        self.assertEqual(rec["participants"], self.world_size)
+
+    def test_ragged_mean_staged_vs_eager(self):
+        # staged path: the reduction runs INSIDE the cached program (zero
+        # MeshCommunication calls) but the padded operand family is gauged;
+        # eager path: _padded_reduce + one comm.shard of the scalar result
+        np_x = np.arange(13, dtype=np.float32)
+        x = ht.array(np_x, split=0)
+        _executor.clear_executor_cache()
+        with metrics():
+            diagnostics.reset()
+            ht.mean(x).parray
+            rep = diagnostics.report()
+        self.assertEqual(rep["collectives"], [])
+        gauges = [g for g in rep["pad_waste"] if g["gshape"] == [13] and g["split"] == 0]
+        self.assertEqual(len(gauges), 1, rep["pad_waste"])
+        padded = x.comm.padded_dim(13)
+        self.assertEqual(gauges[0]["physical_dim"], padded)
+        self.assertEqual(gauges[0]["logical_dim"], 13)
+        self.assertAlmostEqual(gauges[0]["pad_fraction"], (padded - 13) / padded, places=6)
+
+        with metrics(), eager_dispatch():
+            diagnostics.reset()
+            ht.mean(ht.array(np_x, split=0))
+            rep = diagnostics.report()
+        shards = [c for c in rep["collectives"] if c["op"] == "shard"]
+        # one shard for the operand layout (ht.array) + one for the scalar result
+        self.assertEqual(sum(c["count"] for c in shards), 2, rep["collectives"])
+        self.assertEqual(sum(c["bytes"] for c in shards), 13 * 4 + 4)
+        self.assertTrue(
+            any(g["gshape"] == [13] and g["split"] == 0 for g in rep["pad_waste"])
+        )
+
+    def test_shard_map_psum_payload_times_participants(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec
+
+        comm = self.comm
+        p = comm.size
+        xs = jnp.arange(2.0 * p, dtype=jnp.float32)
+        with metrics():
+            diagnostics.reset()
+            fn = shard_map(
+                lambda v: comm.psum(v),
+                mesh=comm.mesh,
+                in_specs=PartitionSpec(comm.axis_name),
+                out_specs=PartitionSpec(comm.axis_name),
+            )
+            fn(xs)
+            rep = diagnostics.report()
+        psums = [c for c in rep["collectives"] if c["op"] == "psum"]
+        self.assertEqual(len(psums), 1, rep["collectives"])
+        self.assertEqual(psums[0]["count"], 1)
+        self.assertEqual(psums[0]["participants"], p)
+        # per-shard payload is (2,) float32 = 8 bytes; logical bytes = 8 * P
+        self.assertEqual(psums[0]["bytes"], 8 * p)
+
+    def test_executor_provider_in_report(self):
+        a = ht.array(np.arange(8, dtype=np.float32), split=0)
+        (a + 1.0).parray
+        (a + 1.0).parray
+        with metrics():
+            rep = diagnostics.report()
+        self.assertIn("executor", rep)
+        for key in ("hits", "misses", "retraces", "programs", "top_signatures"):
+            self.assertIn(key, rep["executor"])
+
+
+class TestBackendHealth(_DiagTestCase):
+    def test_transitions_only(self):
+        # _backend_state survives reset() by design (it is the dedup memory) —
+        # seed a known DOWN state so the assertions don't depend on what any
+        # earlier test or process history left behind
+        diagnostics.record_backend_event(False, "seed known state")
+        diagnostics.reset()
+        first = diagnostics.record_backend_event(True, "probe 1")
+        self.assertTrue(first["transition"])  # up after seeded down
+        self.assertFalse(diagnostics.record_backend_event(True, "probe 2")["transition"])
+        self.assertTrue(diagnostics.record_backend_event(False, "probe 3")["transition"])
+        self.assertFalse(diagnostics.record_backend_event(False, "probe 4")["transition"])
+        self.assertTrue(diagnostics.record_backend_event(True, "probe 5")["transition"])
+        events = diagnostics.report()["backend_events"]
+        self.assertEqual([e["up"] for e in events], [True, False, True])
+        diagnostics.reset()
+
+    def test_outage_window_folding(self):
+        events = [
+            {"t": "2026-01-01T00:00:00Z", "up": True},
+            {"t": "2026-01-01T00:05:00Z", "up": False},
+            {"t": "2026-01-01T00:06:00Z", "up": False},
+            {"t": "2026-01-01T00:15:00Z", "up": True},
+            {"t": "2026-01-01T00:20:00Z", "up": False},
+        ]
+        windows = diagnostics.relay_outage_windows(events)
+        self.assertEqual(len(windows), 2)
+        self.assertEqual(windows[0]["start"], "2026-01-01T00:05:00Z")
+        self.assertEqual(windows[0]["end"], "2026-01-01T00:15:00Z")
+        self.assertEqual(windows[0]["duration_s"], 600)
+        self.assertEqual(windows[1]["start"], "2026-01-01T00:20:00Z")
+        self.assertIsNone(windows[1]["end"])  # outage still open
+        self.assertIsNone(windows[1]["duration_s"])
+
+    def test_diag_log_jsonl(self):
+        # seed a known DOWN state BEFORE pointing the log at our file, so the
+        # "log 1" up-event below is a transition regardless of sibling tests
+        diagnostics.record_backend_event(False, "seed known state")
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "relay.jsonl")
+            old = os.environ.get("HEAT_TPU_DIAG_LOG")
+            os.environ["HEAT_TPU_DIAG_LOG"] = path
+            try:
+                diagnostics.reset()
+                diagnostics.record_backend_event(True, "log 1")
+                diagnostics.record_backend_event(True, "suppressed")
+                diagnostics.record_backend_event(False, "log 2")
+            finally:
+                if old is None:
+                    del os.environ["HEAT_TPU_DIAG_LOG"]
+                else:
+                    os.environ["HEAT_TPU_DIAG_LOG"] = old
+            lines = [json.loads(line) for line in open(path)]
+        self.assertEqual(len(lines), 2)  # transitions only
+        self.assertTrue(lines[0]["backend"]["up"])
+        self.assertFalse(lines[1]["backend"]["up"])
+        diagnostics.reset()
+
+    def test_standalone_file_load(self):
+        # bench.py / __graft_entry__ load diagnostics.py by path BEFORE any
+        # jax import is known to be safe — the module must be stdlib-only
+        code = (
+            "import importlib.util, sys\n"
+            "spec = importlib.util.spec_from_file_location('d', %r)\n"
+            "mod = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(mod)\n"
+            "assert 'jax' not in sys.modules, 'diagnostics.py imported jax at load'\n"
+            "mod.record_backend_event(False, 'standalone')\n"
+            "print(len(mod.relay_outage_windows()))\n"
+        ) % os.path.join(os.path.dirname(diagnostics.__file__), "diagnostics.py")
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=120,
+            env={k: v for k, v in os.environ.items() if k != "HEAT_TPU_DIAG_LOG"},
+        )
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        self.assertEqual(proc.stdout.strip(), "1")
+
+
+class TestZeroOverheadContract(_DiagTestCase):
+    """Instrumented-but-disabled must be byte-identical to uninstrumented: the
+    disabled traced bodies contain no diagnostics constructs at all, so their
+    compiled HLO equals the pre-diagnostics executable's."""
+
+    @staticmethod
+    def _chain_hlos():
+        """Run ``(x + y).sum()`` through the executor and return
+        ``{label: compiled HLO text}`` for every program it cached, re-lowered
+        exactly as the executor jits them (same traced wrapper, same
+        out_shardings / keep_unused)."""
+        _executor.clear_executor_cache()
+        np_x = np.arange(8, dtype=np.float32)
+        np_y = np.full(8, 0.5, dtype=np.float32)
+        x = ht.array(np_x, split=0)
+        y = ht.array(np_y, split=0)
+        (x + y).sum().parray
+        with _executor._lock:
+            entries = [
+                e for e in _executor._programs.values()
+                if e is not _executor.UNSUPPORTED and e.arg_specs is not None
+            ]
+        texts = {}
+        for entry in entries:
+            fn = jax.jit(
+                entry._traced(),
+                out_shardings=entry.out_shardings,
+                keep_unused=entry.donate_index is not None,
+            )
+            texts[entry.label] = fn.lower(*entry.arg_specs).compile().as_text()
+        return texts
+
+    def test_hlo_byte_parity_across_toggles(self):
+        diagnostics.disable()
+        baseline = self._chain_hlos()
+        self.assertGreaterEqual(len(baseline), 2, list(baseline))  # defer + reduce
+        for label, text in baseline.items():
+            self.assertNotIn("/ht.", text, f"disabled build of {label} carries scopes")
+
+        # metrics-only: host-side counting must not touch the executable
+        with metrics():
+            counted = self._chain_hlos()
+        self.assertEqual(counted, baseline, "metrics-only collection changed HLO")
+
+        # tracing: named_scope labels ARE compiled into the metadata
+        with metrics(trace=True):
+            traced = self._chain_hlos()
+        self.assertTrue(
+            any("/ht." in text for text in traced.values()),
+            "HEAT_TPU_TRACE must inject framework-level scope names",
+        )
+
+        # back off: byte-identical to the first disabled build
+        diagnostics.disable()
+        again = self._chain_hlos()
+        self.assertEqual(again, baseline, "disabled HLO must be byte-identical")
+
+    def test_disabled_flag_checks_only(self):
+        # the hot-path gate is a module attribute — flipping it must be enough
+        # (explicitly disable: the ambient suite may run with HEAT_TPU_METRICS=1,
+        # e.g. the CI tier-1 artifact run; _DiagTestCase.tearDown restores it)
+        diagnostics.disable()
+        self.assertFalse(diagnostics._enabled)
+        a = ht.array(np.arange(13, dtype=np.float32), split=0)
+        diagnostics.reset()
+        (a * 2.0).parray
+        self.assertEqual(diagnostics.report()["pad_waste"], [])

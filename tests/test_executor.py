@@ -137,6 +137,53 @@ class TestExecutorStats(TestCase):
         ht.clear_executor_cache()
         self.assertEqual(ht.executor_stats()["programs"], 0)
 
+    def test_top_signature_breakdown(self):
+        _executor.clear_executor_cache()
+        a = ht.array(np.arange(8, dtype=np.float32), split=0)
+        for _ in range(3):
+            ht.add(a, a).parray  # one deferred signature, replayed
+        stats = ht.executor_stats(top=5)
+        self.assertIn("top_signatures", stats)
+        self.assertGreaterEqual(len(stats["top_signatures"]), 1)
+        hottest = stats["top_signatures"][0]
+        for key in ("label", "hits", "compile_s"):
+            self.assertIn(key, hottest)
+        self.assertIn("add", hottest["label"])
+        self.assertGreaterEqual(hottest["hits"], 2)  # replays after the compile
+        self.assertGreater(hottest["compile_s"], 0.0)
+        # default call shape is unchanged: no breakdown unless asked for
+        self.assertNotIn("top_signatures", ht.executor_stats())
+
+    def test_clear_cache_resets_all_stats_reset_keeps_programs(self):
+        # clear_executor_cache: programs AND counters AND per-signature tallies
+        # all go; reset_executor_stats: only the global counters — the program
+        # table and its lifetime hit tallies survive (documented contract)
+        _executor.clear_executor_cache()
+        a = ht.array(np.arange(8, dtype=np.float32), split=0)
+        ht.mul(a, a).parray
+        ht.mul(a, a).parray
+        before = ht.executor_stats(top=5)
+        self.assertGreater(before["programs"], 0)
+        self.assertGreaterEqual(before["top_signatures"][0]["hits"], 1)
+        ht.reset_executor_stats()
+        after_reset = ht.executor_stats(top=5)
+        self.assertEqual(after_reset["hits"], 0)
+        self.assertEqual(after_reset["misses"], 0)
+        self.assertEqual(after_reset["retraces"], 0)
+        self.assertEqual(after_reset["programs"], before["programs"])
+        self.assertEqual(
+            after_reset["top_signatures"][0]["hits"],
+            before["top_signatures"][0]["hits"],
+            "per-signature tallies must survive reset_executor_stats",
+        )
+        ht.clear_executor_cache()
+        cleared = ht.executor_stats(top=5)
+        self.assertEqual(
+            (cleared["hits"], cleared["misses"], cleared["retraces"], cleared["programs"]),
+            (0, 0, 0, 0),
+        )
+        self.assertEqual(cleared["top_signatures"], [])
+
 
 class _ParityBase(TestCase):
     """Executor vs escape-hatch results must be BIT-identical, and the second
