@@ -1,19 +1,27 @@
-"""Dispatch-layer microbenchmark: ops/s for a 64-op elementwise chain.
+"""Dispatch-layer microbenchmark: ops/s for a 64-op elementwise chain and a
+shared-subchain fan-out graph.
 
 Measures the framework-level dispatch throughput of the signature-cached jit
 executor (``heat_tpu/core/_executor.py``) against the fully eager path
-(``HEAT_TPU_EAGER_DISPATCH=1``), on the four layouts that exercise every epilogue:
+(``HEAT_TPU_EAGER_DISPATCH=1``), on the layouts that exercise every epilogue:
 
 - ``split0_even``   — split array, extent divisible by P (shard-constraint epilogue)
 - ``split0_ragged`` — split array, ragged extent (pad re-mask + physical pad fuse)
 - ``unsplit_even`` / ``unsplit_odd`` — replicated operands (no layout epilogue)
+- ``fanout``        — diamond/fan-out graph: a 64-op transcendental shared
+  subchain feeding 8 consumers plus a direct read (ISSUE 5). Exercises the
+  multi-output force: the shared nodes must compile AND execute exactly once
+  (``reexecuted_steady`` — gated at 0 under ``--check``), with every consumer
+  riding one cached one-op program after warm-up. The recorded baseline locks
+  the >=2x ops/s win over the pre-multi-output executor, which re-ran the
+  shared subchain inside every consumer's program.
 
 The chain is 16 cycles of ``x = x + y; x = x * 0.5; x = x - y; x = x + 1.0`` —
 64 framework-level binary ops, 4 distinct cached programs, so the steady state is
-pure signature-cache replay. Ops/s is the 64-op chain count over wall-clock around
-a ``block_until_ready`` sync; best of 5 (host-scheduler noise on shared CPU boxes
-is one-sided, so more repeats converge on the true dispatch ceiling — the
-baseline gate depends on that stability).
+pure signature-cache replay. Ops/s is the per-case framework-op count over
+wall-clock around a ``block_until_ready`` sync; best of 5 (host-scheduler noise
+on shared CPU boxes is one-sided, so more repeats converge on the true dispatch
+ceiling — the baseline gate depends on that stability).
 
 Standalone (bootstraps a virtual CPU mesh, the conftest pattern):
 
@@ -38,6 +46,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 CHAIN_CYCLES = 16  # 4 ops per cycle → 64-op chain
 N_EVEN = 4096
 N_RAGGED = 4093
+# fanout: the shared subchain is transcendental-heavy (8 exp/tanh per cycle
+# set) and the array big enough that re-executing the subchain per consumer
+# (the pre-ISSUE-5 executor's behaviour) dominates the per-execution floor —
+# the case measures redundant XLA *work*, not just execution counts. Cheap
+# elementwise chains would NOT show the win: fused into a consumer kernel
+# their re-execution hides inside the same memory pass.
+N_FANOUT = 1 << 19  # 512k floats
+FANOUT_CONSUMERS = 8
+FANOUT_SHARED_CYCLES = 16  # 4 ops per cycle → 64 shared ops, half transcendental
 
 
 def _bootstrap(devices: int) -> None:
@@ -81,24 +98,46 @@ def _chain(ht, x, y):
     return x
 
 
-def _time_chain(ht, jax, x, y, repeats: int = 5) -> float:
-    """Best-of-``repeats`` seconds for one 64-op chain (after a compile warmup)."""
-    jax.block_until_ready(_chain(ht, x, y).parray)  # compile + warmup
+def _fanout(ht, x, y):
+    """Diamond/fan-out graph: a 64-op transcendental shared subchain, 8
+    consumers forced one by one, and a direct read of the shared value. The
+    multi-output executor materialises the shared chain exactly once (forcing
+    the first consumer emits ``t`` as an extra output); every later consumer
+    replays a cached one-op program over the memoised leaf. The pre-ISSUE-5
+    executor re-executed all 64 shared ops inside every consumer's program."""
+    t = x
+    for _ in range(FANOUT_SHARED_CYCLES):
+        t = ht.exp(t)        # first cycle: x ~ N(0,1) → (0, ~20)
+        t = t + y
+        t = ht.tanh(t)       # bounded (-1, 1) keeps every later cycle tame
+        t = t * 0.5
+    outs = [t * (1.0 + i) for i in range(FANOUT_CONSUMERS)]
+    for o in outs:
+        o.parray
+    t.parray
+    return outs[-1]
+
+
+def _time_case(ht, jax, fn, x, y, repeats: int = 5) -> float:
+    """Best-of-``repeats`` seconds for one case run (after a compile warmup)."""
+    jax.block_until_ready(fn(ht, x, y).parray)  # compile + warmup
     best = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        out = _chain(ht, x, y)
+        out = fn(ht, x, y)
         jax.block_until_ready(out.parray)
         best = min(best, time.perf_counter() - t0)
     return best
 
 
 def _cases(ht, jax, jnp):
-    for name, n, split in (
-        ("split0_even", N_EVEN, 0),
-        ("split0_ragged", N_RAGGED, 0),
-        ("unsplit_even", N_EVEN, None),
-        ("unsplit_odd", N_RAGGED, None),
+    chain_ops = 4 * CHAIN_CYCLES
+    for name, fn, n_ops, n, split in (
+        ("split0_even", _chain, chain_ops, N_EVEN, 0),
+        ("split0_ragged", _chain, chain_ops, N_RAGGED, 0),
+        ("unsplit_even", _chain, chain_ops, N_EVEN, None),
+        ("unsplit_odd", _chain, chain_ops, N_RAGGED, None),
+        ("fanout", _fanout, 4 * FANOUT_SHARED_CYCLES + FANOUT_CONSUMERS, N_FANOUT, 0),
     ):
         x = ht.array(
             jax.random.normal(jax.random.key(0), (n,), jnp.float32), split=split
@@ -106,7 +145,7 @@ def _cases(ht, jax, jnp):
         y = ht.array(
             jax.random.normal(jax.random.key(1), (n,), jnp.float32) * 0.1, split=split
         )
-        yield name, x, y
+        yield name, fn, n_ops, x, y
 
 
 def run(
@@ -132,7 +171,6 @@ def run(
     # exit so an in-process caller (the cb monitor) keeps its metrics
     was_enabled, was_tracing = diagnostics.enabled(), diagnostics.tracing()
     diagnostics.disable()
-    n_ops = 4 * CHAIN_CYCLES
     ndev = len(jax.devices())
     base_cases = (baseline or {}).get(str(ndev), {})
     if baseline is not None and not base_cases:
@@ -146,7 +184,7 @@ def run(
     failed = False
     try:
         records, failed = _run_cases(
-            ht, jax, jnp, _executor, n_ops, ndev, base_cases,
+            ht, jax, jnp, _executor, ndev, base_cases,
             check, baseline_tol, emit,
         )
     finally:
@@ -159,18 +197,18 @@ def run(
     return records
 
 
-def _run_cases(ht, jax, jnp, _executor, n_ops, ndev, base_cases, check, baseline_tol, emit):
+def _run_cases(ht, jax, jnp, _executor, ndev, base_cases, check, baseline_tol, emit):
     records = []
     failed = False
-    for name, x, y in _cases(ht, jax, jnp):
+    for name, fn, n_ops, x, y in _cases(ht, jax, jnp):
         assert os.environ.get("HEAT_TPU_EAGER_DISPATCH") != "1"
-        jax.block_until_ready(_chain(ht, x, y).parray)  # compile, uncounted
+        jax.block_until_ready(fn(ht, x, y).parray)  # compile, uncounted
         _executor.reset_executor_stats()  # so retraces_steady really is steady-state
-        t_exec = _time_chain(ht, jax, x, y)
+        t_exec = _time_case(ht, jax, fn, x, y)
         stats = _executor.executor_stats()
         os.environ["HEAT_TPU_EAGER_DISPATCH"] = "1"
         try:
-            t_eager = _time_chain(ht, jax, x, y)
+            t_eager = _time_case(ht, jax, fn, x, y)
         finally:
             del os.environ["HEAT_TPU_EAGER_DISPATCH"]
         rec = {
@@ -180,6 +218,9 @@ def _run_cases(ht, jax, jnp, _executor, n_ops, ndev, base_cases, check, baseline
             "eager_ops_s": round(n_ops / t_eager, 1),
             "speedup": round(t_eager / t_exec, 2),
             "retraces_steady": stats["retraces"],
+            # multi-output force contract: a shared subchain executes once —
+            # steady-state re-executions must be zero on every case
+            "reexecuted_steady": stats["reexecuted"],
             "devices": ndev,
         }
         records.append(rec)
@@ -191,6 +232,17 @@ def _run_cases(ht, jax, jnp, _executor, n_ops, ndev, base_cases, check, baseline
                     {
                         "error": f"{name}: executor {rec['value']} ops/s is below "
                         f"half the eager path's {rec['eager_ops_s']} ops/s"
+                    }
+                )
+            )
+        if check and rec["reexecuted_steady"] != 0:
+            failed = True
+            emit(
+                json.dumps(
+                    {
+                        "error": f"{name}: {rec['reexecuted_steady']} steady-state "
+                        "re-executions of already-executed deferred nodes — the "
+                        "multi-output force must memoise shared subchains"
                     }
                 )
             )

@@ -40,6 +40,30 @@ chain shape. Interior nodes of a fused graph skip the pad re-mask (pad slots
 may hold garbage mid-program); every *materialised* value is re-masked by its
 root program, so the clean-pad invariant still holds for anything observable.
 
+**Multi-output fused programs.** A fan-out graph (``t = a + b; u = t * 2;
+v = t * 3``) must not re-execute ``t``'s subchain inside every consumer's
+program, so :func:`_force_graph` promotes *interior* nodes to extra program
+outputs when their value has a future: a node referenced by more than one plan
+entry, still wrapped by a live ``DNDarray`` (the weakref registry
+:func:`note_wrapped` populates at wrap time), or held by a deferred graph
+outside this plan (a refcount check). Every emitted value is pad re-masked by
+the program and **memoised** into ``Deferred.value``, so forcing ``u`` also
+materialises ``t``, and forcing ``v`` replays a trivial one-op program over the
+cached leaf. Three more things ride the same linearisation:
+
+- **structural CSE** — plan entries are keyed by ``(op identity, kwargs sig,
+  operand refs)`` rather than node identity, so separately-built identical
+  subexpressions collapse to one slot in the program (and one output slot when
+  memoised);
+- **leaf donation** — a leaf ``jax.Array`` whose only remaining readers are
+  this program's plan entries (``sanitation.sanitize_leaf_donation``, the
+  fused-graph form of the ``out=`` donation contract) is passed through
+  ``donate_argnums``, so pipeline-style ``x = f(x)`` workloads stop holding
+  two full generations of shards;
+- nothing-shared graphs emit exactly one output through the same code path,
+  so single-consumer chains compile byte-identical HLO to the single-output
+  executor.
+
 Escape hatch: ``HEAT_TPU_EAGER_DISPATCH=1`` disables the executor entirely and
 restores the fully eager dispatch path for debugging. Introspection:
 :func:`executor_stats` (hits / misses / retraces / cache size) backs the tests
@@ -49,10 +73,12 @@ and the ``benchmarks/cb/dispatch.py`` microbenchmark.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
+import weakref
 from collections import OrderedDict
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -72,18 +98,33 @@ __all__ = [
 # genuinely polymorphic workloads must not grow the program table without bound.
 _MAX_PROGRAMS = 1024
 
+# Per-program cap on distinct leaf-donation jit variants: each distinct
+# donate_argnums tuple is a separate XLA compile, and a workload whose
+# donation mask churns call-to-call would otherwise compile without bound.
+_MAX_DONATE_VARIANTS = 4
+
 UNSUPPORTED = object()
 """Sentinel a ``build`` callback returns (and the cache stores) for signatures the
 executor cannot stage; the wrapper takes the eager path."""
 
 
 class _Stats:
-    __slots__ = ("hits", "misses", "retraces")
+    __slots__ = (
+        "hits", "misses", "retraces",
+        # multi-output fused-graph telemetry (see _force_graph)
+        "interior_outputs", "reexec_avoided", "reexecuted",
+        "cse_hits", "donated_bytes",
+    )
 
     def __init__(self):
         self.hits = 0
         self.misses = 0
         self.retraces = 0
+        self.interior_outputs = 0
+        self.reexec_avoided = 0
+        self.reexecuted = 0
+        self.cse_hits = 0
+        self.donated_bytes = 0
 
 
 _stats = _Stats()
@@ -136,6 +177,27 @@ def executor_stats(top: int = 0) -> dict:
     identical calls means the replay was pure cache), and ``programs`` (table
     size, unsupported-signature entries included).
 
+    Multi-output fused-graph counters (all global tallies since the last
+    :func:`reset_executor_stats`, maintained by the deferred-graph force):
+
+    - ``interior_outputs`` — interior (non-root) values a forced graph emitted
+      as extra program outputs and memoised into their ``Deferred`` nodes:
+      nodes shared by several plan entries, still wrapped by a live
+      ``DNDarray``, or referenced by a deferred graph outside the plan.
+    - ``reexec_avoided`` — re-executions of a whole subchain that the
+      memoisation made unnecessary: a force that consumed a previously
+      memoised interior value as a plain leaf, or a ``.parray`` read satisfied
+      straight from ``Deferred.value`` without building a program at all.
+    - ``reexecuted`` — plan entries whose node had ALREADY been executed
+      inside an earlier program but was not memoised, so its subchain ran
+      again. Structurally this should stay 0; the ``fanout`` dispatch
+      benchmark gates on it.
+    - ``cse_hits`` — structural-CSE collapses during linearisation: a
+      separately-built node whose ``(op, kwargs, operand refs)`` matched an
+      existing plan entry and took its slot instead of adding one.
+    - ``donated_bytes`` — physical bytes of leaf buffers donated to fused
+      programs (``donate_argnums``; see ``sanitation.sanitize_leaf_donation``).
+
     ``top > 0`` adds ``top_signatures``: the N hottest compiled programs by
     lifetime replay count, each as ``{"label", "hits", "compile_s"}`` —
     ``label`` names the dispatch family and operation (``"defer:add..add[64]"``,
@@ -148,6 +210,11 @@ def executor_stats(top: int = 0) -> dict:
         "misses": _stats.misses,
         "retraces": _stats.retraces,
         "programs": len(_programs),
+        "interior_outputs": _stats.interior_outputs,
+        "reexec_avoided": _stats.reexec_avoided,
+        "reexecuted": _stats.reexecuted,
+        "cse_hits": _stats.cse_hits,
+        "donated_bytes": _stats.donated_bytes,
     }
     if top > 0:
         with _lock:
@@ -169,13 +236,20 @@ def executor_stats(top: int = 0) -> dict:
 
 
 def reset_executor_stats() -> None:
-    """Zero the GLOBAL counters (``hits`` / ``misses`` / ``retraces``). The
-    program table is kept, and so are the per-signature lifetime tallies behind
+    """Zero the GLOBAL counters (``hits`` / ``misses`` / ``retraces`` and the
+    multi-output fused-graph tallies ``interior_outputs`` / ``reexec_avoided``
+    / ``reexecuted`` / ``cse_hits`` / ``donated_bytes``). The program table is
+    kept, and so are the per-signature lifetime tallies behind
     ``executor_stats(top=N)`` — those are properties of the cached programs and
     only drop with them (:func:`clear_executor_cache`)."""
     _stats.hits = 0
     _stats.misses = 0
     _stats.retraces = 0
+    _stats.interior_outputs = 0
+    _stats.reexec_avoided = 0
+    _stats.reexecuted = 0
+    _stats.cse_hits = 0
+    _stats.donated_bytes = 0
 
 
 def clear_executor_cache() -> None:
@@ -208,7 +282,7 @@ _KEY_COMPONENTS: Dict[str, Tuple[str, ...]] = {
           "axis", "keepdims", "mesh", "out"),
     "c": ("family", "operation", "kwargs", "operand_aval", "gshape", "split",
           "axis", "accum_dtype", "mesh", "out"),
-    "defer": ("family", "mesh", "gshape", "split", "graph"),
+    "defer": ("family", "mesh", "gshape", "split", "graph", "outputs"),
 }
 
 
@@ -219,14 +293,15 @@ def _op_label(operation) -> str:
 
 def _key_label(key) -> str:
     """A compact human label for a signature key: dispatch family + op name
-    (``"r:sum"``), or first/last node and length for a fused graph
-    (``"defer:add..mul[64]"``)."""
+    (``"r:sum"``). Fused-graph (``"defer"``) keys carry opaque ``id(op)``
+    tokens, so their readable label (``"defer:add..mul[64]"``) is always
+    passed explicitly to :func:`lookup` by the force — this fallback only
+    reports the plan length."""
     if not isinstance(key, tuple) or not key:
         return repr(key)
     tag = key[0]
-    if tag == "defer" and len(key) >= 5 and isinstance(key[4], tuple) and key[4]:
-        ops = [_op_label(entry[0]) for entry in key[4]]
-        return f"defer:{ops[0]}..{ops[-1]}[{len(ops)}]"
+    if tag == "defer" and len(key) >= 5 and isinstance(key[4], tuple):
+        return f"defer:[{len(key[4])}]"
     if tag in _KEY_COMPONENTS and len(key) >= 2:
         return f"{tag}:{_op_label(key[1])}"
     return repr(tag)
@@ -320,7 +395,11 @@ class _Program:
     ``donate_index`` names the trailing ``out=`` buffer argument; the donating
     and non-donating variants are jitted lazily because donation safety is a
     per-call property of the destination buffer (see
-    ``sanitation.sanitize_donation``), not of the signature.
+    ``sanitation.sanitize_donation``), not of the signature. Fused deferred
+    graphs instead donate *leaf* arguments — ``donate_leaves`` is a tuple of
+    argument positions, and each distinct tuple gets its own lazily-jitted
+    variant (capped at :data:`_MAX_DONATE_VARIANTS`; past the cap the call
+    simply runs undonated — donation is an optimisation, never a dependency).
 
     Telemetry carried per program (all first-call or per-hit trivia — nothing
     on the replay hot path beyond an integer increment in :func:`lookup`):
@@ -332,6 +411,7 @@ class _Program:
     __slots__ = (
         "body", "out_shardings", "donate_index", "meta",
         "label", "hits", "compile_s", "arg_specs", "_plain", "_donating",
+        "_variants",
     )
 
     def __init__(self, body, out_shardings, donate_index, meta):
@@ -345,6 +425,7 @@ class _Program:
         self.arg_specs = None
         self._plain = None
         self._donating = None
+        self._variants = None
 
     def _traced(self):
         body = self.body
@@ -362,18 +443,48 @@ class _Program:
 
         return counted
 
-    def __call__(self, *args, donate: bool = False):
+    def __call__(self, *args, donate: bool = False, donate_leaves: Tuple[int, ...] = ()):
         donating = donate and self.donate_index is not None
-        fn = self._donating if donating else self._plain
+        if donate_leaves:
+            variants = self._variants
+            if (
+                variants is not None
+                and donate_leaves not in variants
+                and len(variants) >= _MAX_DONATE_VARIANTS
+            ):
+                donate_leaves = ()  # variant table full: run undonated
+        if donate_leaves:
+            fn = None if self._variants is None else self._variants.get(donate_leaves)
+        else:
+            fn = self._donating if donating else self._plain
         first = fn is None
         if first:
             # build the jit variant under the executor lock: two threads racing
             # the first call of one program must share ONE jit object (else both
             # trace — double-counted retraces/compile events, wasted compile)
             with _lock:
-                fn = self._donating if donating else self._plain
+                if donate_leaves:
+                    if self._variants is None:
+                        self._variants = {}
+                    fn = self._variants.get(donate_leaves)
+                    if fn is None and len(self._variants) >= _MAX_DONATE_VARIANTS:
+                        # cap re-checked under the lock: first calls racing on
+                        # distinct masks must not grow the table past the
+                        # bound — this call just runs undonated instead
+                        donate_leaves = ()
+                        fn = self._plain
+                else:
+                    fn = self._donating if donating else self._plain
                 first = fn is None
-                if first and donating:
+                if first and donate_leaves:
+                    # fused-graph leaf donation: every donated leaf is a real
+                    # program operand, so no keep_unused is needed
+                    fn = self._variants[donate_leaves] = jax.jit(
+                        self._traced(),
+                        out_shardings=self.out_shardings,
+                        donate_argnums=donate_leaves,
+                    )
+                elif first and donating:
                     # keep_unused: a plain out= overwrite never reads the
                     # destination buffer, and jit would otherwise prune the
                     # argument and lose the input/output aliasing the donation
@@ -410,12 +521,14 @@ class _Program:
         return out
 
 
-def lookup(key, build: Callable[[], Any]) -> Optional[_Program]:
+def lookup(key, build: Callable[[], Any], label: Optional[str] = None) -> Optional[_Program]:
     """The cached :class:`_Program` for ``key``, building it on miss.
 
     ``build()`` returns either ``(body, out_shardings, donate_index, meta)`` or
     :data:`UNSUPPORTED`; both results are cached, so an eager-only signature is
-    rejected in O(1) on every later call. Returns ``None`` for unsupported."""
+    rejected in O(1) on every later call. Returns ``None`` for unsupported.
+    ``label`` overrides the derived :func:`_key_label` — callers whose keys
+    carry opaque id tokens (the deferred-graph force) pass a readable one."""
     # the whole lookup holds the lock: signature keys hash Python-level objects
     # (the Mesh), so even the read path could yield the GIL mid-mutation of the
     # shared OrderedDict; an uncontended RLock costs ~100 ns against a ~40 µs
@@ -431,7 +544,9 @@ def lookup(key, build: Callable[[], Any]) -> Optional[_Program]:
         if diagnostics._enabled:
             # explain the miss BEFORE the table mutates: which signature
             # component changed vs. the nearest cached key of the same family
-            diagnostics.record_dispatch_event("miss", _key_label(key), _miss_reason(key))
+            diagnostics.record_dispatch_event(
+                "miss", label or _key_label(key), _miss_reason(key)
+            )
         threshold = jit_threshold()
         if threshold > 1:
             n = _seen.get(key, 0) + 1
@@ -455,7 +570,7 @@ def lookup(key, build: Callable[[], Any]) -> Optional[_Program]:
             entry = UNSUPPORTED
         else:
             entry = _Program(*built)
-            entry.label = _key_label(key)
+            entry.label = label or _key_label(key)
         while len(_programs) >= _MAX_PROGRAMS:
             _programs.popitem(last=False)
         _programs[key] = entry
@@ -488,9 +603,11 @@ def _zero_pads(value, gshape, split: int):
 # past the cap a node's pending operands are forced first, starting a fresh graph.
 _MAX_FUSED_NODES = 256
 
-# (op identity, kwargs sig, operand aval sigs) -> (shape, dtype) | UNSUPPORTED.
+# (id(op), kwargs sig, operand aval sigs) -> (op, (shape, dtype) | UNSUPPORTED).
 # eval_shape traces the op abstractly — far too slow per dispatch, so the result
-# aval is resolved once per signature and replayed.
+# aval is resolved once per signature and replayed. Keyed on id(op) — hashing a
+# jnp ufunc runs Python-level __hash__, too slow per dispatch — with the op
+# itself stored in the value so the id stays pinned for the entry's lifetime.
 _aval_cache: Dict[Any, Any] = {}
 _MAX_AVALS = 4096
 
@@ -503,11 +620,16 @@ class Deferred:
     values of one aligned ``(gshape, split)`` family, so the node evaluates
     slot-wise with no in-program slicing. ``shape``/``dtype``/``ndim`` expose the
     node's physical aval (``DNDarray._is_padded`` reads them without forcing).
-    ``value`` memoises the forced result: a node forced as the root of its own
-    program becomes a plain array leaf in any later graph that references it."""
+    ``value`` memoises the forced result — set when the node is forced as a
+    root OR emitted as an interior output of another root's program — so the
+    node becomes a plain array leaf in any later graph that references it.
+    ``wref`` weak-references the ``DNDarray`` that wraps this node
+    (:func:`note_wrapped`); ``executed`` marks that the node already ran inside
+    some forced program (the re-execution canary behind
+    ``executor_stats()["reexecuted"]``)."""
 
     __slots__ = ("operation", "fn_kwargs", "operands", "shape", "dtype",
-                 "gshape", "split", "comm", "size", "value")
+                 "gshape", "split", "comm", "size", "value", "wref", "executed")
 
     def __init__(self, operation, fn_kwargs, operands, shape, dtype, gshape, split, comm, size):
         self.operation = operation
@@ -520,6 +642,8 @@ class Deferred:
         self.comm = comm
         self.size = size
         self.value = None
+        self.wref = None
+        self.executed = False
 
     @property
     def ndim(self) -> int:
@@ -527,24 +651,58 @@ class Deferred:
 
     def force(self):
         """Materialise this node (and everything it transitively needs) as one
-        signature-cached program execution."""
+        signature-cached program execution. A value already memoised — by an
+        earlier force that emitted this node as an interior output — is
+        returned as-is: the whole subchain's re-execution was avoided.
+
+        Check-then-force is atomic under the executor lock: two threads racing
+        the same node's first force used to merely duplicate work, but leaf
+        donation would let the winner invalidate buffers the loser's already-
+        linearised plan still references. XLA dispatch is async, so the lock
+        covers launch bookkeeping, not device execution."""
         if self.value is None:
-            self.value = _force(self)
+            with _lock:
+                if self.value is None:
+                    _force_graph((self,))
+                else:
+                    _stats.reexec_avoided += 1
+        else:
+            _stats.reexec_avoided += 1
         return self.value
+
+
+def note_wrapped(node: Deferred, holder) -> None:
+    """Register ``holder`` (a DNDarray) as the live wrapper of ``node``.
+
+    The dispatch layer calls this the moment it wraps a fresh ``Deferred`` into
+    a DNDarray, so the force path can tell which interior nodes are still
+    *reachable* by user code: such a node's value must be emitted from any
+    program that executes it (the user can read it later). The reference is
+    weak — when the wrapping DNDarray is garbage-collected (or rebinds its
+    payload), the node silently stops counting as live; no ``__del__`` hook or
+    explicit deregistration is needed."""
+    node.wref = weakref.ref(holder)
 
 
 def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
     """Build a :class:`Deferred` for ``operation(*operands, **fn_kwargs)``, or
     :data:`UNSUPPORTED` when the op cannot join a fused graph (unhashable
-    operation/kwargs, non-slot-wise result shape, complex result — the eager
-    paths host-route those).
+    kwargs, non-slot-wise result shape, complex result — the eager paths
+    host-route those).
 
     The result aval comes from a cached ``eval_shape`` and must equal the
     physical operand shape: deferral is strictly elementwise over one aligned
-    layout family, everything else takes the immediate one-op staged paths."""
-    op = op_sig(operation)
+    layout family, everything else takes the immediate one-op staged paths.
+
+    Operation identity note: the whole deferred path keys on ``id(operation)``
+    rather than hashing the operation — ``jax.numpy`` ufuncs carry a
+    Python-level ``__hash__`` costing microseconds, and the dispatch hot path
+    would pay it several times per op. The id is safe as a key exactly because
+    every cache that stores such a key also holds a STRONG reference to the
+    operation (the aval-cache value below, a cached program's plan closure),
+    so the id cannot be recycled while the key is live."""
     kwsig = kwargs_sig(fn_kwargs)
-    if op is UNSUPPORTED or kwsig is UNSUPPORTED:
+    if kwsig is UNSUPPORTED:
         return UNSUPPORTED
     phys_shape = None
     sigs = []
@@ -560,9 +718,12 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
             sigs.append(("t", shape, np.dtype(dtype).str))
     if phys_shape is None:
         return UNSUPPORTED
-    akey = (op, kwsig, tuple(sigs))
-    aval = _aval_cache.get(akey)
-    if aval is None:
+    akey = (id(operation), kwsig, tuple(sigs))
+    entry = _aval_cache.pop(akey, None)
+    if entry is not None:
+        _aval_cache[akey] = entry  # re-insert: recency order for eviction below
+        aval = entry[1]
+    else:
         specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for kind, v in operands if kind != "s"]
 
         def abstract(*xs):
@@ -576,8 +737,15 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
         except Exception:
             aval = UNSUPPORTED
         if len(_aval_cache) >= _MAX_AVALS:
-            _aval_cache.clear()
-        _aval_cache[akey] = aval
+            # evict the least-recently-USED half, not everything: a steady-state
+            # workload sitting near the limit must not periodically lose every
+            # cached aval (same policy as the _seen warm-up table; the pop/
+            # re-insert above keeps hit keys at the recent end)
+            for stale in list(_aval_cache)[: _MAX_AVALS // 2]:
+                del _aval_cache[stale]
+        # the stored operation pins its id: an id-keyed entry can never be
+        # aliased by a different (later-allocated) operation while it lives
+        _aval_cache[akey] = (operation, aval)
     if aval is UNSUPPORTED:
         return UNSUPPORTED
     shape, dtype = aval
@@ -588,10 +756,23 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
         if kind == "d" and v.value is None:
             size += v.size
     if size > _MAX_FUSED_NODES:
-        # graph grew past the fusion window: materialise the pending operands
-        # (each as its own cached program) and start a fresh graph from leaves
+        # per-edge size sums count a shared node once per path, so a
+        # diamond-heavy DAG overcounts exponentially — recount the UNIQUE
+        # pending nodes (bounded walk, early exit past the window) before
+        # deciding to spill. Amortised: the exact count becomes this node's
+        # size, deflating its consumers' sums back to reality.
+        size = _pending_count(operands, _MAX_FUSED_NODES)
+    if size > _MAX_FUSED_NODES:
+        # graph genuinely grew past the fusion window: materialise ALL pending
+        # operands through ONE multi-output program and start a fresh graph
+        pending, seen = [], set()
+        for kind, v in operands:
+            if kind == "d" and v.value is None and id(v) not in seen:
+                seen.add(id(v))
+                pending.append(v)
+        _force_graph(tuple(pending))
         operands = tuple(
-            ("a", v.force()) if kind == "d" and v.value is None else (kind, v)
+            ("a", v.value) if kind == "d" and v.value is not None else (kind, v)
             for kind, v in operands
         )
         size = 1
@@ -601,18 +782,75 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
     )
 
 
-def _force(root: Deferred):
-    """Linearise the graph under ``root``, look up / compile its program, run it.
+def _pending_count(operands, cap: int) -> int:
+    """Exact count of unique unforced nodes under ``operands`` (+1 for the node
+    being built), walking at most ``cap`` nodes — past the cap the caller
+    spills, so precision beyond it is wasted work."""
+    seen = set()
+    stack = [v for kind, v in operands if kind == "d" and v.value is None]
+    count = 1
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        count += 1
+        if count > cap:
+            return count
+        for kind, v in n.operands:
+            if kind == "d" and v.value is None:
+                stack.append(v)
+    return count
+
+
+def _force_graph(roots: Tuple[Deferred, ...]) -> None:
+    """Linearise the graph under ``roots``, look up / compile ONE (possibly
+    multi-output) program, run it, and memoise every emitted value into its
+    node's ``Deferred.value``.
 
     The structural signature keys on per-node operation identity + kwargs, the
-    leaf avals, and the exact sharing pattern (a leaf or node referenced twice
-    maps to one slot), so two identically-built chains replay one program."""
+    leaf avals, the exact sharing pattern (a leaf or node referenced twice maps
+    to one slot — structural CSE collapses separately-built identical
+    subexpressions too), and the set of emitted outputs, so two
+    identically-built graphs replay one program.
+
+    Besides the roots, an interior entry is emitted as an extra program output
+    (and memoised) when its value has a future outside this execution:
+
+    - it is referenced by more than one entry of the plan,
+    - a live ``DNDarray`` still wraps one of its nodes (:func:`note_wrapped`),
+    - or a deferred graph OUTSIDE this plan holds one of its nodes — detected
+      by comparing the node's refcount against the plan's own references.
+
+    That last rule is also the leaf-donation safety net: once every
+    externally-reachable entry is memoised, no future force can re-read this
+    program's leaves, so a leaf whose refcount proves the plan is its only
+    reader (``sanitation.sanitize_leaf_donation``) can be donated."""
+    # the whole force runs under the executor lock: the linearised plan, the
+    # refcount-based emission/donation decisions, and the donate-variant cap
+    # must be atomic against other threads' forces — a concurrently donated
+    # leaf must never reach a program call. RLock: re-entrant from
+    # Deferred.force and _Program.__call__'s first-call build.
+    with _lock:
+        _force_graph_locked(roots)
+
+
+def _force_graph_locked(roots: Tuple[Deferred, ...]) -> None:
     leaves: list = []
     leaf_index: Dict[Any, int] = {}
-    entries: list = []  # (operation, fn_kwargs, operand refs) in eval order
-    node_index: Dict[int, int] = {}
+    leaf_donatable: List[bool] = []
+    entries: list = []       # (operation, fn_kwargs, operand refs) in eval order
+    entry_sig: list = []     # (op identity, kwargs sig, refs) — CSE + program key
+    entry_nodes: List[List[Deferred]] = []  # CSE can map several nodes to one entry
+    node_index: Dict[int, int] = {}  # id(node) -> entry idx
+    sig_index: Dict[Any, int] = {}   # structural CSE: entry sig -> entry idx
+    in_refs: Dict[int, int] = {}     # entry idx -> number of DISTINCT consumer entries
+    drefs: Dict[int, int] = {}       # id(node) -> ("d", node) operand refs inside the plan
+    arefs: Dict[int, int] = {}       # id(leaf) -> ("a", leaf) operand refs inside the plan
+    memo_hits = 0
+    cse_hits = 0
 
-    def leaf_ref(value):
+    def leaf_ref(value, donatable: bool):
         if isinstance(value, jax.Array):
             k = ("a", id(value))
         else:
@@ -627,36 +865,110 @@ def _force(root: Deferred):
             idx = len(leaves)
             leaf_index[k] = idx
             leaves.append(value)
+            leaf_donatable.append(donatable)
+        elif not donatable:
+            # the same buffer also arrived as a memoised Deferred value: that
+            # memo must survive this program, so the leaf is never donatable
+            leaf_donatable[idx] = False
         return ("L", idx, operand_sig(value))
 
     def visit(node: Deferred):
+        nonlocal memo_hits, cse_hits
         idx = node_index.get(id(node))
         if idx is not None:
             return ("N", idx)
         refs = []
         for kind, v in node.operands:
-            if kind == "d" and v.value is None:
-                refs.append(visit(v))
-            elif kind == "d":
-                refs.append(leaf_ref(v.value))
+            if kind == "d":
+                drefs[id(v)] = drefs.get(id(v), 0) + 1
+                if v.value is None:
+                    refs.append(visit(v))
+                else:
+                    # a memoised interior value from an earlier force: consume
+                    # it as a plain leaf — its whole subchain is NOT replayed
+                    memo_hits += 1
+                    refs.append(leaf_ref(v.value, False))
+            elif kind == "a":
+                arefs[id(v)] = arefs.get(id(v), 0) + 1
+                refs.append(leaf_ref(v, True))
             else:
-                refs.append(leaf_ref(v))
+                refs.append(leaf_ref(v, False))
+        # id(op), not the op: ufunc __hash__ is Python-level and per-node hot.
+        # Safe: the node (and later the cached program's plan closure) holds
+        # the operation strongly, so the id cannot alias while the sig lives.
+        sig = (id(node.operation), kwargs_sig(node.fn_kwargs), tuple(refs))
+        idx = sig_index.get(sig)
+        if idx is not None:
+            # structural CSE: a separately-built node identical to an existing
+            # plan entry takes its slot (and shares its output if memoised);
+            # its consumers fold into the existing entry's, so no in_refs here
+            cse_hits += 1
+            entry_nodes[idx].append(node)
+            node_index[id(node)] = idx
+            return ("N", idx)
+        if node.executed:
+            # this node already ran inside an earlier program but was not
+            # memoised — its subchain is being re-executed (should not happen
+            # structurally; the fanout benchmark gates on this staying 0)
+            _stats.reexecuted += 1
+        # count DISTINCT consumer entries per child; deferred ops have at most
+        # two operands, so adjacent-duplicate elision is exact (and cheaper
+        # than a set on this per-node hot path)
+        last_ci = None
+        for r in refs:
+            if r[0] == "N":
+                ci = r[1]
+                if ci != last_ci:
+                    in_refs[ci] += 1
+                    last_ci = ci
         idx = len(entries)
         entries.append((node.operation, node.fn_kwargs, tuple(refs)))
+        entry_sig.append(sig)
+        entry_nodes.append([node])
+        sig_index[sig] = idx
         node_index[id(node)] = idx
+        in_refs[idx] = 0
         return ("N", idx)
 
-    visit(root)
+    root_idxs = [visit(r)[1] for r in roots]
+    root = roots[0]
     gshape, split = root.gshape, root.split
     padded = tuple(root.shape) != gshape
     if padded and diagnostics._enabled:
         diagnostics.record_pad_waste(gshape, split, root.shape[split])
-    key = (
-        "defer", root.comm.mesh, gshape, split,
-        tuple((op_sig(op), kwargs_sig(kw), refs) for op, kw, refs in entries),
-    )
+
+    # ---- which entries leave the program as outputs (and get memoised)
+    emit = set(root_idxs)
+    for idx in range(len(entries)):
+        if idx in emit:
+            continue
+        if in_refs[idx] > 1:
+            emit.add(idx)
+            continue
+        for node in entry_nodes[idx]:
+            w = node.wref
+            if w is not None:
+                holder = w()
+                if holder is not None and holder._payload is node:
+                    emit.add(idx)  # a live DNDarray still wraps this node
+                    break
+            # expected refcount when the plan is the node's only holder: its
+            # ("d", node) operand tuples inside the plan + the entry_nodes
+            # list + the loop variable + getrefcount's own argument. Anything
+            # beyond that is a deferred graph outside this plan.
+            if sys.getrefcount(node) > drefs.get(id(node), 0) + 3:
+                emit.add(idx)
+                break
+    out_idxs = tuple(sorted(emit))
+    single = len(out_idxs) == 1
+
+    key = ("defer", root.comm.mesh, gshape, split, tuple(entry_sig), out_idxs)
     plan = tuple(entries)
-    out_shardings = root.comm.sharding(root.ndim, split)
+    label = (
+        f"defer:{_op_label(plan[0][0])}..{_op_label(plan[-1][0])}[{len(plan)}]"
+    )
+    sharding = root.comm.sharding(root.ndim, split)
+    out_shardings = sharding if single else (sharding,) * len(out_idxs)
 
     def build():
         def body(*leaf_vals):
@@ -664,28 +976,99 @@ def _force(root: Deferred):
             for operation, fn_kwargs, refs in plan:
                 args = [leaf_vals[r[1]] if r[0] == "L" else vals[r[1]] for r in refs]
                 vals.append(operation(*args, **fn_kwargs))
-            result = vals[-1]
-            if padded:
-                result = _zero_pads(result, gshape, split)
-            return result
+            outs = []
+            for i in out_idxs:
+                result = vals[i]
+                if padded:
+                    # every MATERIALISED value is re-masked (interior pad
+                    # garbage never escapes); non-emitted entries stay unmasked
+                    result = _zero_pads(result, gshape, split)
+                outs.append(result)
+            return outs[0] if single else tuple(outs)
 
         return body, out_shardings, None, None
 
-    prog = lookup(key, build)
+    prog = lookup(key, build, label=label)
+    n_interior = len(out_idxs) - len(set(root_idxs))
     if prog is None:
         # signature still under the warm-up jit threshold: evaluate the plan
-        # eagerly — same per-node op order, one re-mask at the root (interior
-        # pad garbage never touches logical slots), layout pinned by comm.shard
-        # exactly like the eager dispatch path
+        # eagerly — same per-node op order, one re-mask per emitted value
+        # (interior pad garbage never touches logical slots), layout pinned by
+        # comm.shard exactly like the eager dispatch path. Interior values are
+        # memoised identically to the compiled path.
         vals = []
         for operation, fn_kwargs, refs in plan:
             args = [leaves[r[1]] if r[0] == "L" else vals[r[1]] for r in refs]
             vals.append(operation(*args, **fn_kwargs))
-        result = vals[-1]
-        if padded:
-            result = _zero_pads(result, gshape, split)
-        return root.comm.shard(result, split)
-    return prog(*leaves)
+        outs = []
+        for i in out_idxs:
+            result = vals[i]
+            if padded:
+                result = _zero_pads(result, gshape, split)
+            outs.append(root.comm.shard(result, split))
+    else:
+        donate_idx: Tuple[int, ...] = ()
+        if any(leaf_donatable):
+            from . import sanitation
+
+            # a donated buffer is only usable when XLA can alias it onto an
+            # output of the same aval, one donation per output slot — donating
+            # more just burns a jit variant and warns "donated buffers were
+            # not usable"
+            out_avals: Dict[Any, int] = {}
+            for i in out_idxs:
+                aval = (tuple(entry_nodes[i][0].shape), np.dtype(entry_nodes[i][0].dtype))
+                out_avals[aval] = out_avals.get(aval, 0) + 1
+            picked = []
+            for i in range(len(leaves)):
+                # persistent refs when the plan is this leaf's last reader:
+                # its ("a", leaf) operand tuples + the leaves list. The call
+                # shape passes the subscript temp directly — no loop variable
+                # or enumerate tuple may hold an extra reference here.
+                if not leaf_donatable[i]:
+                    continue
+                aval = (tuple(leaves[i].shape), np.dtype(leaves[i].dtype))
+                if out_avals.get(aval, 0) > 0 and sanitation.sanitize_leaf_donation(
+                    leaves[i], arefs.get(id(leaves[i]), 0) + 1
+                ):
+                    out_avals[aval] -= 1
+                    picked.append(i)
+            donate_idx = tuple(picked)
+            variants = prog._variants
+            if (
+                donate_idx
+                and variants is not None
+                and donate_idx not in variants
+                and len(variants) >= _MAX_DONATE_VARIANTS
+            ):
+                # the program's donate-variant table is full and this mask has
+                # no compiled variant: the call would run undonated, so decide
+                # that here — the donated_bytes tally must reflect reality
+                donate_idx = ()
+        if donate_idx:
+            donated = sum(leaves[i].nbytes for i in donate_idx)
+            _stats.donated_bytes += donated
+            if diagnostics._enabled:
+                diagnostics.counter("executor.donated_leaf_bytes", donated)
+        outs = prog(*leaves, donate_leaves=donate_idx)
+        if single:
+            outs = (outs,)
+    _stats.interior_outputs += n_interior
+    _stats.reexec_avoided += memo_hits
+    _stats.cse_hits += cse_hits
+    if diagnostics._enabled:
+        if n_interior:
+            diagnostics.counter("executor.interior_outputs", n_interior)
+        if memo_hits:
+            diagnostics.counter("executor.reexec_avoided", memo_hits)
+        if cse_hits:
+            diagnostics.counter("executor.cse_collapses", cse_hits)
+    for value, i in zip(outs, out_idxs):
+        for node in entry_nodes[i]:
+            node.value = value
+    for nodes in entry_nodes:
+        for node in nodes:
+            node.executed = True
 
 
 # The executor's section of ht.diagnostics.report(): global counters plus the

@@ -250,10 +250,14 @@ def _binary_defer(operation, t1, t2, fn_kwargs):
     )
     if node is _executor.UNSUPPORTED:
         return NotImplemented
-    return DNDarray(
+    res = DNDarray(
         node, proto.gshape, types.canonical_heat_type(node.dtype), proto.split,
         proto.device, proto.comm, True,
     )
+    # liveness registry: while this DNDarray lives, any program that executes
+    # the node must emit (memoise) its value — the user can still read it
+    _executor.note_wrapped(node, res)
+    return res
 
 
 def _local_defer(operation, x, fn_kwargs):
@@ -266,10 +270,12 @@ def _local_defer(operation, x, fn_kwargs):
     )
     if node is _executor.UNSUPPORTED:
         return NotImplemented
-    return DNDarray(
+    res = DNDarray(
         node, x.gshape, types.canonical_heat_type(node.dtype), x.split,
         x.device, x.comm, x.balanced,
     )
+    _executor.note_wrapped(node, res)
+    return res
 
 
 def _pad_physical(value, padded_shape: Tuple[int, ...], split: int):

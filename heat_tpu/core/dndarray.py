@@ -198,8 +198,19 @@ class DNDarray:
         zero-padded to ``ceil(n/P)*P`` so shards are an exact 1/P.
 
         A payload deferred by the dispatch executor (a pending fused-op graph
-        node) is **forced** here: the whole chain compiles/replays as one
-        program and the concrete result replaces the node."""
+        node) is **forced** here: the whole reachable graph compiles/replays as
+        one (possibly multi-output) program and the concrete result replaces
+        the node. If a previous force already emitted this node's value as an
+        interior program output, ``force()`` returns that memoised value with
+        no new program at all.
+
+        Lifecycle note: replacing the payload here also ends this array's role
+        in the executor's liveness registry — ``_executor.note_wrapped`` holds
+        only a *weak* reference to this DNDarray, and the force path's
+        emission check additionally verifies ``holder._payload is node``, so
+        neither this rebind, :meth:`_rebind_physical`, nor plain garbage
+        collection of the DNDarray needs an explicit ``__del__``
+        deregistration hook."""
         arr = self.__array
         if isinstance(arr, Deferred):
             arr = arr.force()
@@ -210,7 +221,10 @@ class DNDarray:
     def _payload(self):
         """The raw payload WITHOUT forcing: a concrete ``jax.Array`` or a pending
         :class:`~._executor.Deferred` node. Only the dispatch layer should read
-        this — everything else wants :attr:`parray`."""
+        this — everything else wants :attr:`parray`. (The executor's liveness
+        check reads it through the weakref registry: a node whose wrapping
+        DNDarray died, or whose wrapper was rebound to a different payload, no
+        longer counts as reachable and is not memoised at force time.)"""
         return self.__array
 
     @property

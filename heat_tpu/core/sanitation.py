@@ -15,6 +15,7 @@ from .dndarray import DNDarray
 __all__ = [
     "sanitize_sequence",
     "sanitize_donation",
+    "sanitize_leaf_donation",
     "sanitize_in",
     "sanitize_infinity",
     "sanitize_in_tensor",
@@ -99,6 +100,48 @@ def sanitize_donation(out: DNDarray, operand_arrays: Sequence) -> bool:
     # and the getrefcount argument itself. Anything beyond that is an external
     # holder we must not invalidate.
     return sys.getrefcount(buf) <= 3
+
+
+def _call_ref_overhead() -> int:
+    """How many references one Python-level call layer adds to an argument —
+    CPython 3.10 keeps both the caller's stack slot and the callee's frame
+    local alive during the call (+2); 3.11+ consumes the stack slot into the
+    frame (+1). Measured once at import so the leaf-donation refcount contract
+    is exact on either convention."""
+    import sys
+
+    probe = object()
+
+    def _measure(x):
+        return sys.getrefcount(x)
+
+    return _measure(probe) - sys.getrefcount(probe)
+
+
+_LEAF_CALL_OVERHEAD = _call_ref_overhead()
+
+
+def sanitize_leaf_donation(buf, plan_refs: int) -> bool:
+    """Whether a fused-graph *leaf* buffer may be donated to the deferred
+    executor's program (``donate_argnums`` on the leaf's argument position).
+
+    The fused-graph form of :func:`sanitize_donation`'s contract: donation
+    invalidates the buffer, so it is only safe when the forcing program is the
+    buffer's last reader. ``plan_refs`` is the number of *persistent*
+    references the caller accounts for — the plan's own operand-tuple slots
+    plus the caller's bookkeeping containers (the executor passes its leaf
+    list). On top of those, ``getrefcount``'s own argument and this call's
+    argument-passing references (:func:`_call_ref_overhead`, measured at
+    import) are expected; anything beyond is an external holder — a live
+    ``DNDarray`` payload, a user-held ``x.parray``, or a deferred graph
+    outside the forcing plan — and refuses donation.
+
+    When this returns False the program still runs without the aliasing —
+    correctness never depends on donation.
+    """
+    import sys
+
+    return sys.getrefcount(buf) <= plan_refs + 1 + _LEAF_CALL_OVERHEAD
 
 
 def sanitize_distribution(
