@@ -16,6 +16,46 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 DEFAULT_LOG = os.path.join(_HERE, "DIAG_RELAY.jsonl")
 
 _DIAG = None
+_RESILIENCE = None
+
+
+def load_resilience():
+    """The ``heat_tpu.core.resilience`` module as a standalone instance (one per
+    process, cached), bound to the SAME standalone diagnostics instance as
+    :func:`load_diagnostics` so relay probes, retries and breaker transitions
+    land in one event stream. Returns ``None`` only if the file is unloadable —
+    callers treat policies/breakers as best-effort and keep their single-attempt
+    behaviour."""
+    global _RESILIENCE
+    if _RESILIENCE is not None:
+        return _RESILIENCE
+    import sys
+
+    already = sys.modules.get("heat_tpu.core.resilience")
+    if already is not None:
+        # the package is imported (the backend is up by definition): share its
+        # instance outright instead of splitting breaker/plan state
+        _RESILIENCE = already
+        return already
+    path = os.path.join(_HERE, "heat_tpu", "core", "resilience.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_heat_tpu_resilience", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception:
+        return None
+    # visible to a LATER package import, whose module-level adoption hook then
+    # shares this instance's breaker registry (one relay-health state per process)
+    sys.modules.setdefault("_heat_tpu_resilience", mod)
+    diag = load_diagnostics()
+    if diag is not None:
+        # inject the shared diagnostics instance (the relative import inside
+        # resilience.py degrades to None under a file-path load) and register
+        # the report section it could not register itself
+        mod.diagnostics = diag
+        diag.register_provider("resilience", mod.resilience_stats)
+    _RESILIENCE = mod
+    return mod
 
 
 def load_diagnostics():

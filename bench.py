@@ -35,6 +35,25 @@ def _diagnostics():
     return _diag_bootstrap.load_diagnostics()
 
 
+def _resilience():
+    """The ht.resilience policy/breaker engine, loaded standalone like the
+    diagnostics module (stdlib-only import, shares the same standalone
+    diagnostics instance). None only if the file is unloadable."""
+    import os
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    if here not in sys.path:
+        sys.path.insert(0, here)
+    import _diag_bootstrap
+
+    return _diag_bootstrap.load_resilience()
+
+
+class _RelayDown(RuntimeError):
+    """One failed relay probe — the retryable unit of the reachability policy."""
+
+
 # Every relay probe this round, in order: {"t", "up", "latency_s", "detail"}.
 # Transitions additionally land in the diagnostics log (HEAT_TPU_DIAG_LOG,
 # defaulted to DIAG_RELAY.jsonl next to this file) and the outage-window
@@ -310,11 +329,23 @@ def _bench_dispatch(devices: int = 8, timeout_s: float = 900.0) -> list:
 def _probe_backend(timeout_s: float = 150.0, detail: str = "") -> bool:
     """One killable-subprocess backend-initialisation probe (an in-process
     ``jax.devices()`` against a dead relay blocks in C and ignores signals),
-    recorded — timestamp, result, latency — into the probe history and the
-    diagnostics backend-event stream."""
+    recorded — timestamp, result, latency — into the probe history, the
+    diagnostics backend-event stream, and the ``backend.relay`` circuit
+    breaker. Honors the deterministic fault plan at site ``probe.relay``
+    (an injected fault is a recorded DOWN probe with zero wall-clock cost)."""
     import subprocess
     import sys
 
+    res = _resilience()
+    breaker = None
+    if res is not None:
+        breaker = res.relay_breaker()
+        if res._armed:
+            entry = res.fault_signal("probe.relay")
+            if entry is not None:
+                breaker.record_failure(f"injected {entry.kind}")
+                _record_probe(False, 0.0, detail or f"injected {entry.kind}")
+                return False
     t0 = time.perf_counter()
     up = False
     why = "probe failed"
@@ -328,20 +359,59 @@ def _probe_backend(timeout_s: float = 150.0, detail: str = "") -> bool:
         why = "backend up" if up else f"probe rc={proc.returncode}"
     except subprocess.TimeoutExpired:
         why = f"probe timed out after {timeout_s:.0f}s"
+    if breaker is not None:
+        if up:
+            breaker.record_success()
+        else:
+            breaker.record_failure(why)
     _record_probe(up, time.perf_counter() - t0, detail or why)
     return up
 
 
-def _backend_reachable(timeout_s: float = 150.0, attempts: int = 3) -> bool:
-    """Logged, timestamped relay-health probes (replacing the old silent retry
-    loop): each attempt is recorded via :func:`_record_probe`; retries because
-    the axon relay has transient outages."""
-    for attempt in range(attempts):
-        if _probe_backend(timeout_s, detail=f"reachability probe {attempt + 1}/{attempts}"):
-            return True
-        if attempt < attempts - 1:
-            time.sleep(60)
-    return False
+def _backend_reachable(
+    timeout_s: float = 150.0, attempts: int = 3, sleep=time.sleep
+) -> bool:
+    """Relay reachability under ONE resilience.Policy (folding what used to be
+    three hand-rolled loops — this probe ladder, the matmul retry below, and
+    the round-long relay wait): every attempt is a logged, timestamped probe
+    that lands in the probe history and outage windows exactly once.
+
+    ``HEAT_TPU_RELAY_DEADLINE_S`` switches the ladder to the round-long shape:
+    unlimited attempts with 60 s → 15 min exponential backoff until the
+    deadline, so one healthy window anywhere in a round is caught without a
+    bespoke loop staying armed for hours."""
+    import os
+
+    res = _resilience()
+    if res is None:  # resilience unloadable: degrade to a single logged probe
+        return _probe_backend(timeout_s, detail="reachability probe (no policy)")
+    try:
+        deadline = float(os.environ.get("HEAT_TPU_RELAY_DEADLINE_S", "0"))
+    except ValueError:
+        deadline = 0.0
+    if deadline > 0:
+        policy = res.Policy(
+            max_attempts=None, backoff_base=60.0, jitter=0.0,
+            deadline_s=deadline, max_delay_s=900.0,
+        )
+    else:
+        policy = res.Policy(max_attempts=attempts, backoff_base=60.0,
+                            jitter=0.0, max_delay_s=60.0)
+
+    state = {"n": 0}
+
+    def probe_once():
+        state["n"] += 1
+        if not _probe_backend(
+            timeout_s, detail=f"reachability probe {state['n']}"
+        ):
+            raise _RelayDown(f"probe {state['n']} down")
+        return True
+
+    try:
+        return policy.run("probe.relay", probe_once, sleep=sleep)
+    except _RelayDown:
+        return False
 
 
 def _cache_path():
@@ -443,20 +513,33 @@ def main():
     on_tpu = jax.default_backend() != "cpu"
 
     # The axon relay has transient ~1 min outages where every op fails; retry the
-    # headline metric, and isolate each extra so one flaky segment can't kill the
-    # whole JSON line the driver records.
+    # headline metric under the same resilience.Policy shape as the relay probes,
+    # and isolate each extra so one flaky segment can't kill the whole JSON line
+    # the driver records.
     tflops = None
-    for attempt in range(3):
+    state = {"attempt": 0}
+
+    def matmul_attempt():
+        state["attempt"] += 1
         try:
-            n, dtype_name, tflops = _bench_matmul(ht, jax, jnp, on_tpu)
-            break
+            return _bench_matmul(ht, jax, jnp, on_tpu)
         except Exception:
             traceback.print_exc(file=sys.stderr)
             # a failed on-chip attempt is ambiguous (real regression vs relay
             # death mid-run): probe and record so the round's JSON can tell
-            _probe_backend(detail=f"matmul attempt {attempt + 1}/3 raised")
-            if attempt < 2:
-                time.sleep(60)
+            _probe_backend(detail=f"matmul attempt {state['attempt']}/3 raised")
+            raise
+
+    res = _resilience()
+    try:
+        if res is not None:
+            policy = res.Policy(max_attempts=3, backoff_base=60.0, jitter=0.0,
+                                max_delay_s=60.0)
+            n, dtype_name, tflops = policy.run("bench.matmul", matmul_attempt)
+        else:
+            n, dtype_name, tflops = matmul_attempt()
+    except Exception:
+        pass  # attempts and probes are already logged; fall through to the null record
     if tflops is None:
         # backend reachable but the benchmark itself failed — that could be a real
         # regression, so report it honestly instead of substituting cached numbers

@@ -85,7 +85,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import diagnostics
+from . import diagnostics, resilience
 
 __all__ = [
     "executor_stats",
@@ -114,6 +114,9 @@ class _Stats:
         # multi-output fused-graph telemetry (see _force_graph)
         "interior_outputs", "reexec_avoided", "reexecuted",
         "cse_hits", "donated_bytes",
+        # failure hardening: compiled programs whose compile/execute failed and
+        # whose call fell back to the eager path (see fallback_after_failure)
+        "eager_fallbacks",
     )
 
     def __init__(self):
@@ -125,6 +128,7 @@ class _Stats:
         self.reexecuted = 0
         self.cse_hits = 0
         self.donated_bytes = 0
+        self.eager_fallbacks = 0
 
 
 _stats = _Stats()
@@ -198,6 +202,15 @@ def executor_stats(top: int = 0) -> dict:
     - ``donated_bytes`` — physical bytes of leaf buffers donated to fused
       programs (``donate_argnums``; see ``sanitation.sanitize_leaf_donation``).
 
+    Failure-hardening counters (see :func:`fallback_after_failure`):
+
+    - ``eager_fallbacks`` — compiled-program calls whose compile or execution
+      failed and whose dispatch fell back to the eager path (same math, no
+      user-visible data loss).
+    - ``quarantined`` — labels of signatures evicted to the permanent eager
+      path after repeated failures, each mapped to the explained reason
+      (phase, failure count, exception).
+
     ``top > 0`` adds ``top_signatures``: the N hottest compiled programs by
     lifetime replay count, each as ``{"label", "hits", "compile_s"}`` —
     ``label`` names the dispatch family and operation (``"defer:add..add[64]"``,
@@ -215,7 +228,10 @@ def executor_stats(top: int = 0) -> dict:
         "reexecuted": _stats.reexecuted,
         "cse_hits": _stats.cse_hits,
         "donated_bytes": _stats.donated_bytes,
+        "eager_fallbacks": _stats.eager_fallbacks,
     }
+    with _lock:
+        stats["quarantined"] = dict(_quarantined)
     if top > 0:
         with _lock:
             progs = [
@@ -250,6 +266,7 @@ def reset_executor_stats() -> None:
     _stats.reexecuted = 0
     _stats.cse_hits = 0
     _stats.donated_bytes = 0
+    _stats.eager_fallbacks = 0
 
 
 def clear_executor_cache() -> None:
@@ -263,6 +280,7 @@ def clear_executor_cache() -> None:
         _programs.clear()
         _seen.clear()
         _aval_cache.clear()
+        _quarantined.clear()
     reset_executor_stats()
 
 
@@ -411,7 +429,7 @@ class _Program:
     __slots__ = (
         "body", "out_shardings", "donate_index", "meta",
         "label", "hits", "compile_s", "arg_specs", "_plain", "_donating",
-        "_variants",
+        "_variants", "failures", "proven",
     )
 
     def __init__(self, body, out_shardings, donate_index, meta):
@@ -426,6 +444,8 @@ class _Program:
         self._plain = None
         self._donating = None
         self._variants = None
+        self.failures = 0   # compile/execute failures (fallback_after_failure)
+        self.proven = False  # at least one call of any variant has succeeded
 
     def _traced(self):
         body = self.body
@@ -444,6 +464,11 @@ class _Program:
         return counted
 
     def __call__(self, *args, donate: bool = False, donate_leaves: Tuple[int, ...] = ()):
+        if resilience._armed:
+            # every program call is one countable "executor.execute" event; the
+            # fault fires BEFORE any dispatch, so argument buffers (including
+            # donation candidates) are still intact when the caller falls back
+            resilience.maybe_fault("executor.execute")
         donating = donate and self.donate_index is not None
         if donate_leaves:
             variants = self._variants
@@ -476,6 +501,12 @@ class _Program:
                 else:
                     fn = self._donating if donating else self._plain
                 first = fn is None
+                if first and resilience._armed:
+                    # a jit variant is about to be built: the deterministic
+                    # hook for injected COMPILE failures (real ones surface
+                    # from the first fn(*args) below — both land in the same
+                    # except/fallback path at the call site)
+                    resilience.maybe_fault("executor.compile")
                 if first and donate_leaves:
                     # fused-graph leaf donation: every donated leaf is a real
                     # program operand, so no keep_unused is needed
@@ -518,6 +549,7 @@ class _Program:
             self.compile_s += dt
             if diagnostics._enabled:
                 diagnostics.record_compile(self.label or "program", dt)
+        self.proven = True
         return out
 
 
@@ -576,6 +608,72 @@ def lookup(key, build: Callable[[], Any], label: Optional[str] = None) -> Option
         _programs[key] = entry
         _stats.misses += 1
         return None if entry is UNSUPPORTED else entry
+
+
+# ------------------------------------------------------------- failure hardening
+# A compiled program whose compile or execution fails must not take the user's
+# computation down with it: the dispatch wrappers and the fused-graph force
+# catch the failure, count it, and replay the SAME math on the eager path (the
+# original dispatch code, which never left). A signature that keeps failing is
+# quarantined — its table entry becomes UNSUPPORTED, so every later dispatch
+# takes the eager path in O(1) — with the reason kept for executor_stats().
+
+_quarantined: "OrderedDict[str, str]" = OrderedDict()
+_MAX_QUARANTINED = 64
+
+
+def quarantine_threshold() -> int:
+    """Failures of one signature before it is quarantined to the eager path
+    (``HEAT_TPU_QUARANTINE_AFTER``, default 3). Read per failure — never on a
+    success path."""
+    try:
+        return max(1, int(os.environ.get("HEAT_TPU_QUARANTINE_AFTER", "3")))
+    except ValueError:
+        return 3
+
+
+def fallback_after_failure(key, prog: "_Program", exc: BaseException,
+                           donated: Sequence = ()) -> bool:
+    """Account one compiled-program failure and decide whether the eager path
+    may safely re-run the op.
+
+    Returns False — the caller must re-raise — only when a buffer donated to
+    the failed call was already invalidated by XLA (replaying would read
+    garbage; the donation contract holds every leaf reference until the call
+    succeeds, so this only happens when a failure strikes *after* dispatch
+    consumed the buffer). Otherwise the failure is counted
+    (``eager_fallbacks``), recorded in ht.diagnostics with the exception type
+    and program label, and the signature is quarantined once it has failed
+    :func:`quarantine_threshold` times."""
+    for buf in donated:
+        if isinstance(buf, jax.Array) and buf.is_deleted():
+            diagnostics.record_resilience_event(
+                "executor.execute", "data-loss",
+                f"{prog.label or _key_label(key)}: donated buffer invalidated "
+                f"by failed call ({type(exc).__name__}) — no eager replay possible",
+            )
+            return False
+    label = prog.label or _key_label(key)
+    phase = "execute" if prog.proven else "compile"
+    with _lock:
+        _stats.eager_fallbacks += 1
+        prog.failures += 1
+        reason = (
+            f"{phase} failure {prog.failures}: {type(exc).__name__}: {exc}"
+        )
+        if prog.failures >= quarantine_threshold() and _programs.get(key) is prog:
+            _programs[key] = UNSUPPORTED
+            while len(_quarantined) >= _MAX_QUARANTINED:
+                _quarantined.popitem(last=False)
+            _quarantined[label] = reason
+            diagnostics.record_resilience_event(
+                f"executor.{phase}", "quarantine", f"{label}: {reason}"
+            )
+    if diagnostics._enabled:
+        diagnostics.record_fallback(
+            f"executor.{phase}", f"{label}: {type(exc).__name__}: {exc}"
+        )
+    return True
 
 
 # ------------------------------------------------------------------ padded layout
@@ -734,7 +832,16 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
         try:
             out = jax.eval_shape(abstract, *specs)
             aval = (tuple(out.shape), np.dtype(out.dtype))
-        except Exception:
+        except Exception as exc:
+            # this signature cannot join a fused graph — the caller takes the
+            # staged/eager path, which raises the user-visible error if the op
+            # is genuinely broken. Visible, not silent: per-site counter +
+            # reason (exception type + op label) in ht.diagnostics.
+            if diagnostics._enabled:
+                diagnostics.record_fallback(
+                    "dispatch.defer",
+                    f"{_op_label(operation)}: {type(exc).__name__}: {exc}",
+                )
             aval = UNSUPPORTED
         if len(_aval_cache) >= _MAX_AVALS:
             # evict the least-recently-USED half, not everything: a steady-state
@@ -990,22 +1097,30 @@ def _force_graph_locked(roots: Tuple[Deferred, ...]) -> None:
 
     prog = lookup(key, build, label=label)
     n_interior = len(out_idxs) - len(set(root_idxs))
-    if prog is None:
-        # signature still under the warm-up jit threshold: evaluate the plan
-        # eagerly — same per-node op order, one re-mask per emitted value
-        # (interior pad garbage never touches logical slots), layout pinned by
-        # comm.shard exactly like the eager dispatch path. Interior values are
-        # memoised identically to the compiled path.
+
+    def replay_eager():
+        # op-by-op replay of the plan: same per-node op order, one re-mask per
+        # emitted value (interior pad garbage never touches logical slots),
+        # layout pinned by comm.shard exactly like the eager dispatch path.
+        # Used below the warm-up jit threshold AND as the no-data-loss fallback
+        # when a compiled program's compile/execute fails — the `leaves` list
+        # holds every input reference until the program call succeeds, so the
+        # replay always has live buffers to read. Interior values are memoised
+        # identically to the compiled path.
         vals = []
         for operation, fn_kwargs, refs in plan:
             args = [leaves[r[1]] if r[0] == "L" else vals[r[1]] for r in refs]
             vals.append(operation(*args, **fn_kwargs))
-        outs = []
+        results = []
         for i in out_idxs:
             result = vals[i]
             if padded:
                 result = _zero_pads(result, gshape, split)
-            outs.append(root.comm.shard(result, split))
+            results.append(root.comm.shard(result, split))
+        return results
+
+    if prog is None:
+        outs = replay_eager()
     else:
         donate_idx: Tuple[int, ...] = ()
         if any(leaf_donatable):
@@ -1045,14 +1160,31 @@ def _force_graph_locked(roots: Tuple[Deferred, ...]) -> None:
                 # no compiled variant: the call would run undonated, so decide
                 # that here — the donated_bytes tally must reflect reality
                 donate_idx = ()
-        if donate_idx:
-            donated = sum(leaves[i].nbytes for i in donate_idx)
-            _stats.donated_bytes += donated
-            if diagnostics._enabled:
-                diagnostics.counter("executor.donated_leaf_bytes", donated)
-        outs = prog(*leaves, donate_leaves=donate_idx)
-        if single:
-            outs = (outs,)
+        try:
+            if donate_idx:
+                # donation-bearing calls never ride a retry policy: a retry
+                # after a post-dispatch failure would re-read buffers XLA may
+                # already have invalidated — the fallback below decides instead
+                outs = prog(*leaves, donate_leaves=donate_idx)
+            elif resilience._active:
+                outs = resilience.guard("executor.execute", prog, *leaves, inject=False)
+            else:
+                outs = prog(*leaves)
+            if single:
+                outs = (outs,)
+            if donate_idx:
+                # tallied only after the call succeeded: a failed (or injected)
+                # donated dispatch never actually aliased the buffers
+                donated = sum(leaves[i].nbytes for i in donate_idx)
+                _stats.donated_bytes += donated
+                if diagnostics._enabled:
+                    diagnostics.counter("executor.donated_leaf_bytes", donated)
+        except Exception as exc:
+            if not fallback_after_failure(
+                key, prog, exc, donated=[leaves[i] for i in donate_idx]
+            ):
+                raise
+            outs = replay_eager()
     _stats.interior_outputs += n_interior
     _stats.reexec_avoided += memo_hits
     _stats.cse_hits += cse_hits

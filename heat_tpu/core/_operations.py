@@ -147,8 +147,15 @@ def _on_accelerator(value) -> bool:
     (``array.device`` returns a NamedSharding for mesh-committed arrays, so a
     ``.platform`` check on it silently passes — use the device set instead.)"""
     try:
+        # the known failure modes: tracers/np values without .devices()
+        # (AttributeError), deleted or uncommitted buffers (RuntimeError) —
+        # anything else (KeyboardInterrupt-class included) must propagate
         return any(d.platform != "cpu" for d in value.devices())
-    except Exception:
+    except (AttributeError, RuntimeError, TypeError) as exc:
+        if diagnostics._enabled:
+            diagnostics.record_fallback(
+                "dispatch.on_accelerator", f"{type(exc).__name__}: {exc}"
+            )
         return True  # unknown placement: moving is the safe choice
 
 
@@ -336,7 +343,14 @@ def _binary_jit(
             prog = _executor.lookup(key, build)
             if prog is None:
                 return NotImplemented
-            value = prog(*phys)
+            try:
+                value = prog(*phys)
+            except Exception as exc:
+                # compile/execute failure: replay the same math on the eager
+                # path below (no donation involved — always safe)
+                if not _executor.fallback_after_failure(key, prog, exc):
+                    raise
+                return NotImplemented
             if diagnostics._enabled:
                 _note_pad_waste(out_shape, out_split, comm)
             return DNDarray(
@@ -419,11 +433,20 @@ def _binary_jit(
         return NotImplemented
     if diagnostics._enabled and phys_shape != tuple(out_shape):
         _note_pad_waste(out_shape, out_split, comm)
-    if has_out:
-        value = prog(*vals, out.parray, donate=donate)
-        out._rebind_physical(value)
-        return out
-    value = prog(*vals)
+    try:
+        if has_out:
+            value = prog(*vals, out.parray, donate=donate)
+            out._rebind_physical(value)
+            return out
+        value = prog(*vals)
+    except Exception as exc:
+        # the eager path re-runs the op unless a donated out buffer was
+        # already invalidated by the failed call (then replay would be a lie)
+        if not _executor.fallback_after_failure(
+            key, prog, exc, donated=(out.parray,) if has_out and donate else ()
+        ):
+            raise
+        return NotImplemented
     return DNDarray(
         value, tuple(out_shape), types.canonical_heat_type(value.dtype),
         out_split, device or get_device(), comm, True,
@@ -476,7 +499,15 @@ def _local_jit(operation, x, out, fn_kwargs):
 
         try:
             probe = jax.eval_shape(logical, aval)
-        except Exception:
+        except Exception as exc:
+            # unstageable signature: the eager path below re-runs the op and
+            # surfaces the real error if there is one. Counted + explained in
+            # ht.diagnostics (exception type + op label), never silent.
+            if diagnostics._enabled:
+                diagnostics.record_fallback(
+                    "dispatch.local",
+                    f"{_executor._op_label(operation)}: {type(exc).__name__}: {exc}",
+                )
             return _executor.UNSUPPORTED
         rshape = tuple(probe.shape)
         if jnp.issubdtype(probe.dtype, jnp.complexfloating):
@@ -514,10 +545,22 @@ def _local_jit(operation, x, out, fn_kwargs):
     if kind == "out":
         sanitation.sanitize_out(out, gshape, split, x.device)
         donate = sanitation.sanitize_donation(out, [xval])
-        value = prog(xval, out.parray, donate=donate)
+        try:
+            value = prog(xval, out.parray, donate=donate)
+        except Exception as exc:
+            if not _executor.fallback_after_failure(
+                key, prog, exc, donated=(out.parray,) if donate else ()
+            ):
+                raise
+            return NotImplemented
         out._rebind_physical(value)
         return out
-    value = prog(xval)
+    try:
+        value = prog(xval)
+    except Exception as exc:
+        if not _executor.fallback_after_failure(key, prog, exc):
+            raise
+        return NotImplemented
     return DNDarray(
         value, tuple(rshape), types.canonical_heat_type(value.dtype), rsplit,
         x.device, x.comm, x.balanced,
@@ -588,7 +631,12 @@ def _reduce_jit(operation, x, axis, out_split, out, keepdims, fn_kwargs):
 
         try:
             rsd = jax.eval_shape(logical, aval)
-        except Exception:
+        except Exception as exc:
+            if diagnostics._enabled:
+                diagnostics.record_fallback(
+                    "dispatch.reduce",
+                    f"{_executor._op_label(operation)}: {type(exc).__name__}: {exc}",
+                )
             return _executor.UNSUPPORTED
         rshape = tuple(rsd.shape)
         if jnp.issubdtype(rsd.dtype, jnp.complexfloating):
@@ -622,10 +670,22 @@ def _reduce_jit(operation, x, axis, out_split, out, keepdims, fn_kwargs):
     if kind == "out":
         sanitation.sanitize_out(out, rshape, fsplit, x.device)
         donate = sanitation.sanitize_donation(out, [xval])
-        value = prog(xval, out.parray, donate=donate)
+        try:
+            value = prog(xval, out.parray, donate=donate)
+        except Exception as exc:
+            if not _executor.fallback_after_failure(
+                key, prog, exc, donated=(out.parray,) if donate else ()
+            ):
+                raise
+            return NotImplemented
         out._rebind_physical(value)
         return out
-    value = prog(xval)
+    try:
+        value = prog(xval)
+    except Exception as exc:
+        if not _executor.fallback_after_failure(key, prog, exc):
+            raise
+        return NotImplemented
     return DNDarray(
         value, tuple(rshape), types.canonical_heat_type(value.dtype), fsplit,
         x.device, x.comm, True,
@@ -701,10 +761,22 @@ def _cum_jit(operation, x, axis, out, target, fn_kwargs):
     if prog.meta == ("out",):
         sanitation.sanitize_out(out, gshape, split, x.device)
         donate = sanitation.sanitize_donation(out, [xval])
-        value = prog(xval, out.parray, donate=donate)
+        try:
+            value = prog(xval, out.parray, donate=donate)
+        except Exception as exc:
+            if not _executor.fallback_after_failure(
+                key, prog, exc, donated=(out.parray,) if donate else ()
+            ):
+                raise
+            return NotImplemented
         out._rebind_physical(value)
         return out
-    value = prog(xval)
+    try:
+        value = prog(xval)
+    except Exception as exc:
+        if not _executor.fallback_after_failure(key, prog, exc):
+            raise
+        return NotImplemented
     return DNDarray(
         value, tuple(gshape), types.canonical_heat_type(value.dtype), split,
         x.device, x.comm, x.balanced,

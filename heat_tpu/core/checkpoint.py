@@ -1,35 +1,96 @@
 """Sharded checkpoint / resume (SURVEY §5: the reference has data-level I/O only —
 ``ht.save``/``ht.load`` hyperslabs, heat/core/io.py:58-238 — and no training-state
 checkpointing; users fall back to ``torch.save``. The TPU build adds the idiomatic
-equivalent: orbax/tensorstore sharded checkpoints of DNDarrays and parameter pytrees,
-written per-shard from device buffers, restored with the target sharding).
+equivalent: manifest-backed atomic checkpoints of DNDarrays and parameter pytrees).
 
-Surface:
+Failure contract (ISSUE 6 — the resilience tentpole):
+
+- **Atomic commit.** A checkpoint is assembled in a same-filesystem temp
+  directory — every leaf payload written through ``resilience.atomic_write``
+  (write-to-temp + fsync + rename), the manifest written LAST — and committed
+  by renaming the previous checkpoint ASIDE, the new one in, then deleting the
+  old. Readers see either the previous checkpoint or the complete new one; a
+  crash mid-save leaves an uncommitted ``.tmp.<pid>`` (and possibly a
+  ``.old.<pid>`` holding the pre-crash state), which the next save of the same
+  target sweeps — recovering a stranded ``.old`` back into place when the
+  commit itself died between the two renames.
+- **Partial-write detection.** ``manifest.json`` records every leaf's byte
+  length and SHA-256. :func:`load_checkpoint` verifies all of them before
+  rebuilding the tree and raises :class:`CheckpointCorrupt` naming each torn /
+  missing / mismatched file — a torn write can never silently restore garbage.
+- **Policy-driven retry.** Leaf and manifest writes run under the
+  ``checkpoint.write`` / ``checkpoint.manifest`` resilience policies (three
+  attempts, exponential backoff by default; override with
+  ``resilience.set_policy``), and the fault-injection plan can tear or fail
+  any write deterministically (``tests/test_checkpoint.py``).
+
+Surface (unchanged):
 
 - :func:`save_checkpoint` / :func:`load_checkpoint` — a pytree of DNDarrays /
   jax.Arrays / numpy leaves to a checkpoint directory.
-- :class:`CheckpointManager` — rolling step-numbered checkpoints with retention,
-  the shape training loops want for resume.
+- :class:`CheckpointManager` — rolling step-numbered checkpoints with retention;
+  ``latest_step`` / ``all_steps`` skip (and report) corrupt step directories
+  instead of tripping over them.
 
-DNDarray leaves are stored as their global ``jax.Array`` plus ``split`` metadata and
-come back as DNDarrays with the same distribution.
+DNDarray leaves are stored as their global value plus ``split`` metadata and
+come back as DNDarrays with the template tree's distribution. Payloads are raw
+little-endian buffers named in the manifest (not ``.npy``), so extension dtypes
+(bfloat16) round-trip without pickling.
+
+Scale note: collection is host-memory O(global) per leaf (multi-controller
+leaves cross-host-gather and process 0 serialises all I/O) — correct at every
+world size, but not the per-shard streaming a pod-scale save needs. The
+ROADMAP "parallel checkpoint/ingest I/O" item builds per-process chunked
+writes ON TOP of this manifest/verification format; the integrity and
+atomicity contracts here are the part that stays.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+import re
+import shutil
+from typing import Any, List, Optional
 
 import numpy as np
 
 import jax
 
+from . import diagnostics, resilience
+from . import types as _types
 from .communication import sanitize_comm
 from .devices import sanitize_device
 from .dndarray import DNDarray
-from . import types as _types
 
-__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointManager"]
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "CheckpointManager",
+    "CheckpointCorrupt",
+    "SCHEMA",
+    "MANIFEST_NAME",
+]
+
+SCHEMA = "heat-tpu-checkpoint/1"
+MANIFEST_NAME = "manifest.json"
+
+_WRITE_SITE = "checkpoint.write"
+_MANIFEST_SITE = "checkpoint.manifest"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed integrity verification on restore. ``problems``
+    lists one human-readable finding per torn / missing / mismatched file."""
+
+    def __init__(self, directory: str, problems: List[str]):
+        self.directory = directory
+        self.problems = list(problems)
+        detail = "; ".join(self.problems)
+        super().__init__(
+            f"checkpoint at {directory!r} is corrupt or partially written: {detail}"
+        )
 
 
 def _to_storable(tree: Any):
@@ -83,97 +144,325 @@ def _rebuild_tree(tree: Any, restored: dict, comm, device) -> Any:
     return jax.tree.unflatten(treedef, out_leaves)
 
 
+def _dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def _dtype_from_name(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # extension dtypes (bfloat16, float8_*) live here
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _host_value(value) -> np.ndarray:
+    """One leaf as a host numpy array. Multi-controller DNDarray shards were
+    already collected by the caller; a non-addressable raw jax.Array still
+    needs the cross-host gather."""
+    if isinstance(value, jax.Array) and not value.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(value))
+    return np.asarray(value)
+
+
+def _is_writer() -> bool:
+    return jax.process_index() == 0
+
+
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"heat_tpu.checkpoint:{tag}")
+
+
+def _sweep_stale(directory: str) -> None:
+    """Clean up what a crashed earlier save left behind, whatever its pid:
+    uncommitted ``.tmp.*`` assembly dirs are deleted; a ``.old.*`` backup is
+    restored to the canonical path when the crash stranded it there (the
+    commit died between the two renames and the target is gone), else
+    deleted — it was an already-replaced generation."""
+    base = os.path.basename(directory)
+    parent = os.path.dirname(directory) or "."
+    try:
+        names = os.listdir(parent)
+    except FileNotFoundError:
+        return
+    for name in sorted(names):
+        full = os.path.join(parent, name)
+        if name.startswith(f"{base}.tmp."):
+            shutil.rmtree(full, ignore_errors=True)
+        elif name.startswith(f"{base}.old."):
+            if not os.path.exists(directory):
+                try:
+                    os.rename(full, directory)
+                    diagnostics.record_resilience_event(
+                        "checkpoint.save", "recovered",
+                        f"restored crash-stranded backup {name} to {directory}",
+                    )
+                    continue
+                except OSError:
+                    pass
+            shutil.rmtree(full, ignore_errors=True)
+
+
 def save_checkpoint(tree: Any, directory: str, *, force: bool = True) -> None:
-    """Write a pytree of DNDarrays / jax.Arrays / numpy leaves to ``directory``.
-
-    Each shard streams from its own device buffer through tensorstore — the
-    checkpoint analogue of the per-rank hyperslab writes in ``save_hdf5``.
-    """
-    import orbax.checkpoint as ocp
-
+    """Write a pytree of DNDarrays / jax.Arrays / numpy leaves to ``directory``
+    atomically (temp-dir assembly + manifest-last + one-rename commit; see the
+    module header for the failure contract)."""
     directory = os.path.abspath(directory)
+    if os.path.exists(directory) and not force:
+        raise FileExistsError(f"checkpoint directory {directory} exists (force=False)")
     _, arrays, splits = _to_storable(tree)
-    ckptr = ocp.StandardCheckpointer()
-    ckptr.save(
-        directory,
-        {"arrays": arrays, "splits": np.asarray(splits, dtype=np.int64)},
-        force=force,
-    )
-    ckptr.wait_until_finished()
+    host = [_host_value(a) for a in arrays]  # collective: every process joins
+    if not _is_writer():
+        _barrier(f"save:{directory}")
+        return
+    _sweep_stale(directory)
+    tmpdir = f"{directory}.tmp.{os.getpid()}"
+    shutil.rmtree(tmpdir, ignore_errors=True)
+    os.makedirs(tmpdir)
+    try:
+        entries = []
+        for i, (value, split) in enumerate(zip(host, splits)):
+            name = f"leaf_{i}.bin"
+            payload = np.ascontiguousarray(value).tobytes()
+
+            def write(tmp_path: str, _payload=payload) -> None:
+                with open(tmp_path, "wb") as fh:
+                    fh.write(_payload)
+
+            resilience.atomic_write(
+                os.path.join(tmpdir, name), write, site=_WRITE_SITE
+            )
+            entries.append(
+                {
+                    "file": name,
+                    "shape": [int(s) for s in value.shape],
+                    "dtype": _dtype_name(value.dtype),
+                    "split": int(split),
+                    "nbytes": len(payload),
+                    "sha256": hashlib.sha256(payload).hexdigest(),
+                }
+            )
+        manifest = {"schema": SCHEMA, "leaves": entries}
+
+        def write_manifest(tmp_path: str) -> None:
+            with open(tmp_path, "w") as fh:
+                json.dump(manifest, fh, indent=2, sort_keys=True)
+                fh.write("\n")
+
+        # manifest LAST: its presence marks the leaf set complete, so a crash
+        # between leaf writes can never masquerade as a restorable checkpoint
+        resilience.atomic_write(
+            os.path.join(tmpdir, MANIFEST_NAME), write_manifest, site=_MANIFEST_SITE
+        )
+        resilience.fsync_dir(tmpdir)
+        # overwrite without an unprotected window: the previous checkpoint is
+        # renamed ASIDE (never rmtree'd first), the new one renamed in, and
+        # only then is the old one deleted — a crash between the renames
+        # leaves the old bits recoverable at <directory>.old.<pid>, and a
+        # failed commit rename puts them straight back
+        backup = None
+        if os.path.exists(directory):
+            backup = f"{directory}.old.{os.getpid()}"
+            shutil.rmtree(backup, ignore_errors=True)
+            os.rename(directory, backup)
+        try:
+            os.rename(tmpdir, directory)
+        except BaseException:
+            if backup is not None:
+                try:
+                    os.rename(backup, directory)
+                except OSError:
+                    pass  # old bits stay recoverable at the backup path
+            raise
+        if backup is not None:
+            shutil.rmtree(backup, ignore_errors=True)
+        resilience.fsync_dir(os.path.dirname(directory) or ".")
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        # the barrier must run even when the writer FAILED: the other
+        # processes are already parked in their matching sync, and a write
+        # error must surface as this exception — never as a distributed hang
+        _barrier(f"save:{directory}")
 
 
-def load_checkpoint(
-    tree: Any, directory: str, *, device=None, comm=None
-) -> Any:
+def read_manifest(directory: str) -> dict:
+    """The parsed manifest of a checkpoint directory, or :class:`CheckpointCorrupt`
+    when it is absent or unparseable (a torn / foreign / pre-manifest layout)."""
+    path = os.path.join(os.path.abspath(directory), MANIFEST_NAME)
+    if not os.path.exists(path):
+        raise CheckpointCorrupt(
+            directory, [f"{MANIFEST_NAME} missing (incomplete or torn checkpoint)"]
+        )
+    try:
+        with open(path) as fh:
+            manifest = json.load(fh)
+    except ValueError as exc:
+        raise CheckpointCorrupt(directory, [f"{MANIFEST_NAME} unparseable: {exc}"])
+    if manifest.get("schema") != SCHEMA:
+        raise CheckpointCorrupt(
+            directory, [f"unknown manifest schema {manifest.get('schema')!r}"]
+        )
+    return manifest
+
+
+def verify_checkpoint(directory: str, manifest: Optional[dict] = None) -> List[str]:
+    """Integrity-check every leaf payload against the manifest (existence, byte
+    length, SHA-256). Returns the list of problems — empty means sound.
+    ``manifest`` skips the re-read when the caller already parsed it."""
+    directory = os.path.abspath(directory)
+    if manifest is None:
+        manifest = read_manifest(directory)
+    problems = []
+    for entry in manifest["leaves"]:
+        path = os.path.join(directory, entry["file"])
+        if not os.path.exists(path):
+            problems.append(f"{entry['file']}: missing")
+            continue
+        size = os.path.getsize(path)
+        if size != entry["nbytes"]:
+            problems.append(
+                f"{entry['file']}: torn write — {size} bytes on disk, "
+                f"manifest expects {entry['nbytes']}"
+            )
+            continue
+        digest = hashlib.sha256()
+        with open(path, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                digest.update(chunk)
+        if digest.hexdigest() != entry["sha256"]:
+            problems.append(f"{entry['file']}: sha256 mismatch (silent corruption)")
+    return problems
+
+
+def load_checkpoint(tree: Any, directory: str, *, device=None, comm=None) -> Any:
     """Restore a checkpoint written by :func:`save_checkpoint`.
 
     ``tree`` supplies the structure and, for DNDarray leaves, the target split:
     pass the model/optimizer pytree you want overwritten — the standard functional
-    restore shape.
+    restore shape. Every payload is verified against the manifest first; a torn
+    or corrupt checkpoint raises :class:`CheckpointCorrupt` (reported into the
+    diagnostics resilience-event stream) instead of restoring garbage.
     """
-    import orbax.checkpoint as ocp
-
     directory = os.path.abspath(directory)
     comm = sanitize_comm(comm) if comm is not None else None
     device = sanitize_device(device) if device is not None else None
-    _, arrays, _ = _to_storable(tree)
-    ckptr = ocp.StandardCheckpointer()
-    restored = ckptr.restore(
-        directory,
-        {"arrays": arrays, "splits": np.zeros(len(arrays), dtype=np.int64)},
-    )
-    return _rebuild_tree(tree, restored, comm, device)
+    manifest = read_manifest(directory)
+    problems = verify_checkpoint(directory, manifest)
+    if problems:
+        diagnostics.record_resilience_event(
+            "checkpoint.restore", "corrupt", f"{directory}: " + "; ".join(problems)
+        )
+        raise CheckpointCorrupt(directory, problems)
+    template_leaves = jax.tree.leaves(tree)
+    entries = manifest["leaves"]
+    if len(entries) != len(template_leaves):
+        raise CheckpointCorrupt(
+            directory,
+            [
+                f"leaf count mismatch: checkpoint holds {len(entries)}, "
+                f"template tree has {len(template_leaves)}"
+            ],
+        )
+    values, splits = [], []
+    for entry in entries:
+        with open(os.path.join(directory, entry["file"]), "rb") as fh:
+            payload = fh.read()
+        arr = np.frombuffer(payload, dtype=_dtype_from_name(entry["dtype"]))
+        arr = arr.reshape(tuple(entry["shape"]))
+        if entry["split"] == -2:
+            # plain leaves restore as-is into the user's tree: frombuffer views
+            # are read-only, so hand back a writable array (DNDarray leaves go
+            # through jnp.asarray, which copies anyway)
+            arr = arr.copy()
+        values.append(arr)
+        splits.append(entry["split"])
+    return _rebuild_tree(tree, {"arrays": values, "splits": splits}, comm, device)
+
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
 
 
 class CheckpointManager:
     """Rolling step-numbered checkpoints with retention — resume-oriented training
-    checkpointing (no reference equivalent; SURVEY §5 notes the gap)."""
+    checkpointing (no reference equivalent; SURVEY §5 notes the gap).
+
+    Each step lives in its own atomically-committed ``step_<n>`` directory.
+    Enumeration (``all_steps`` / ``latest_step``) counts only directories whose
+    manifest parses — a corrupt or partially-deleted step directory is skipped
+    (and reported via diagnostics) rather than crashing resume or masquerading
+    as the latest state; restoring it explicitly still raises
+    :class:`CheckpointCorrupt` with the per-file findings."""
 
     def __init__(self, directory: str, *, max_to_keep: int = 3):
-        import orbax.checkpoint as ocp
-
+        if max_to_keep < 1:
+            raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
         self._directory = os.path.abspath(directory)
-        self._manager = ocp.CheckpointManager(
-            self._directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
-        )
+        self._max_to_keep = max_to_keep
+        os.makedirs(self._directory, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._directory, f"step_{int(step)}")
 
     def save(self, step: int, tree: Any) -> None:
-        import orbax.checkpoint as ocp
-
-        _, arrays, splits = _to_storable(tree)
-        self._manager.save(
-            step,
-            args=ocp.args.StandardSave(
-                {"arrays": arrays, "splits": np.asarray(splits, dtype=np.int64)}
-            ),
-        )
-        self._manager.wait_until_finished()
+        save_checkpoint(tree, self._step_dir(step), force=True)
+        steps = self.all_steps()
+        if _is_writer():
+            # corrupt (unrestorable) step dirs don't count toward the
+            # retention bound, but they must not leak disk forever either —
+            # GC them alongside the rotation
+            valid = set(steps)
+            for name in os.listdir(self._directory):
+                m = _STEP_RE.match(name)
+                if m and int(m.group(1)) not in valid:
+                    shutil.rmtree(
+                        os.path.join(self._directory, name), ignore_errors=True
+                    )
+        while len(steps) > self._max_to_keep:
+            oldest = steps.pop(0)
+            if _is_writer():
+                shutil.rmtree(self._step_dir(oldest), ignore_errors=True)
 
     def restore(self, tree: Any, step: Optional[int] = None, *, device=None, comm=None) -> Any:
-        import orbax.checkpoint as ocp
-
-        comm = sanitize_comm(comm) if comm is not None else None
-        device = sanitize_device(device) if device is not None else None
         if step is None:
-            step = self._manager.latest_step()
+            step = self.latest_step
             if step is None:
                 raise FileNotFoundError(f"no checkpoints under {self._directory}")
-        _, arrays, _ = _to_storable(tree)
-        restored = self._manager.restore(
-            step,
-            args=ocp.args.StandardRestore(
-                {"arrays": arrays, "splits": np.zeros(len(arrays), dtype=np.int64)}
-            ),
-        )
-        return _rebuild_tree(tree, restored, comm, device)
+        return load_checkpoint(tree, self._step_dir(step), device=device, comm=comm)
+
+    def all_steps(self) -> List[int]:
+        """Sorted steps with a readable manifest; corrupt step directories are
+        skipped and reported, never fatal."""
+        steps = []
+        try:
+            names = os.listdir(self._directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _STEP_RE.match(name)
+            if not m:
+                continue
+            step = int(m.group(1))
+            try:
+                read_manifest(os.path.join(self._directory, name))
+            except CheckpointCorrupt as exc:
+                diagnostics.record_resilience_event(
+                    "checkpoint.scan", "corrupt-step",
+                    f"step {step} at {self._directory}: {exc.problems[0]}",
+                )
+                continue
+            steps.append(step)
+        return sorted(steps)
 
     @property
     def latest_step(self) -> Optional[int]:
-        return self._manager.latest_step()
-
-    def all_steps(self):
-        return sorted(self._manager.all_steps())
+        steps = self.all_steps()
+        return steps[-1] if steps else None
 
     def close(self) -> None:
-        self._manager.close()
+        """Kept for API compatibility with the previous orbax-backed manager."""

@@ -35,7 +35,21 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from . import diagnostics
+from . import diagnostics, resilience
+
+
+def _guarded(site, fn, *args, **kwargs):
+    """Run one collective (or layout) invocation under ht.resilience.
+
+    Idle fast path: one module-attribute read. When a fault plan is armed or a
+    site policy is registered, the call goes through ``resilience.guard`` —
+    injected faults fire per attempt and the site policy retries. Collectives
+    execute at trace time (pure functions of tracers), so a retried call
+    re-traces identically and the compiled HLO never changes (the
+    byte-parity contract in ``tests/test_resilience.py``)."""
+    if resilience._active:
+        return resilience.guard(site, fn, *args, **kwargs)
+    return fn(*args, **kwargs)
 
 # Multi-controller bootstrap must run BEFORE anything touches the XLA backend —
 # and importing heat_tpu itself does (the COMM_WORLD mesh below calls
@@ -351,17 +365,18 @@ class MeshCommunication(Communication):
                 widths = [(0, 0)] * np_value.ndim
                 widths[split] = (0, self.padded_dim(np_value.shape[split]) - np_value.shape[split])
                 np_value = np.pad(np_value, widths)
-            return jax.make_array_from_callback(
-                np_value.shape, target, lambda idx: np_value[idx]
+            return _guarded(
+                "comm.shard", jax.make_array_from_callback,
+                np_value.shape, target, lambda idx: np_value[idx],
             )
         if not ragged:
-            return jax.device_put(array, target)
+            return _guarded("comm.shard", jax.device_put, array, target)
         m = self.padded_dim(array.shape[split])
         pad_shape = array.shape[:split] + (m - array.shape[split],) + array.shape[split + 1 :]
         padded = jnp.concatenate(
             [jnp.asarray(array), jnp.zeros(pad_shape, jnp.asarray(array).dtype)], axis=split
         )
-        return jax.device_put(padded, target)
+        return _guarded("comm.shard", jax.device_put, padded, target)
 
     # ------------------------------------------------------------------ collectives
     # Functional collectives usable inside shard_map blocks. Names kept close to the
@@ -396,19 +411,19 @@ class MeshCommunication(Communication):
     def psum(self, x, axis_name: Optional[str] = None):
         if diagnostics._enabled:
             self._record_collective("psum", axis_name, x)
-        return jax.lax.psum(x, axis_name or self.axis_name)
+        return _guarded("comm.psum", jax.lax.psum, x, axis_name or self.axis_name)
 
     Allreduce = psum
 
     def pmax(self, x, axis_name: Optional[str] = None):
         if diagnostics._enabled:
             self._record_collective("pmax", axis_name, x)
-        return jax.lax.pmax(x, axis_name or self.axis_name)
+        return _guarded("comm.pmax", jax.lax.pmax, x, axis_name or self.axis_name)
 
     def pmin(self, x, axis_name: Optional[str] = None):
         if diagnostics._enabled:
             self._record_collective("pmin", axis_name, x)
-        return jax.lax.pmin(x, axis_name or self.axis_name)
+        return _guarded("comm.pmin", jax.lax.pmin, x, axis_name or self.axis_name)
 
     def all_gather(self, x, axis: int = 0, axis_name: Optional[str] = None, tiled: bool = True):
         """Allgather along array axis ``axis`` (reference ``__allgather_like``
@@ -416,7 +431,10 @@ class MeshCommunication(Communication):
         by ``jax.lax.all_gather(axis=...)``)."""
         if diagnostics._enabled:
             self._record_collective("all_gather", axis_name, x)
-        return jax.lax.all_gather(x, axis_name or self.axis_name, axis=axis, tiled=tiled)
+        return _guarded(
+            "comm.all_gather", jax.lax.all_gather,
+            x, axis_name or self.axis_name, axis=axis, tiled=tiled,
+        )
 
     Allgather = all_gather
 
@@ -424,9 +442,10 @@ class MeshCommunication(Communication):
         """Alltoall (reference ``__alltoall_like`` ``communication.py:1236``)."""
         if diagnostics._enabled:
             self._record_collective("all_to_all", axis_name, x)
-        return jax.lax.all_to_all(
-            x, axis_name or self.axis_name, split_axis=split_axis, concat_axis=concat_axis,
-            tiled=True,
+        return _guarded(
+            "comm.all_to_all", jax.lax.all_to_all,
+            x, axis_name or self.axis_name, split_axis=split_axis,
+            concat_axis=concat_axis, tiled=True,
         )
 
     Alltoall = all_to_all
@@ -435,7 +454,10 @@ class MeshCommunication(Communication):
         """Point-to-point send/recv pattern (reference Send/Recv ``communication.py:541-707``)."""
         if diagnostics._enabled:
             self._record_collective("ppermute", axis_name, x)
-        return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
+        return _guarded(
+            "comm.ppermute", jax.lax.ppermute,
+            x, axis_name or self.axis_name, perm=perm,
+        )
 
     def ring_shift(self, x, shift: int = 1, axis_name: Optional[str] = None):
         """Rotate shards around the ring — the TPU form of the reference's ring algorithms
@@ -444,7 +466,10 @@ class MeshCommunication(Communication):
             self._record_collective("ring_shift", axis_name, x)
         n = self.size
         perm = [(i, (i + shift) % n) for i in range(n)]
-        return jax.lax.ppermute(x, axis_name or self.axis_name, perm=perm)
+        return _guarded(
+            "comm.ring_shift", jax.lax.ppermute,
+            x, axis_name or self.axis_name, perm=perm,
+        )
 
     def broadcast(self, x, root: int = 0, axis_name: Optional[str] = None):
         """Bcast from shard ``root`` (reference ``communication.py:736``).
@@ -457,6 +482,9 @@ class MeshCommunication(Communication):
         """
         if diagnostics._enabled:
             self._record_collective("broadcast", axis_name, x)
+        return _guarded("comm.broadcast", self._broadcast_impl, x, root, axis_name)
+
+    def _broadcast_impl(self, x, root, axis_name):
         name = axis_name or self.axis_name
         if not isinstance(name, str):
             idx = jax.lax.axis_index(name)
@@ -491,6 +519,9 @@ class MeshCommunication(Communication):
         """
         if diagnostics._enabled:
             self._record_collective("exscan", axis_name, x)
+        return _guarded("comm.exscan", self._exscan_impl, x, axis_name)
+
+    def _exscan_impl(self, x, axis_name):
         name = axis_name or self.axis_name
         if not isinstance(name, str):
             idx = jax.lax.axis_index(name)
@@ -525,7 +556,7 @@ class MeshCommunication(Communication):
         if diagnostics._enabled:
             self._record_collective("reduce", axis_name, x)
         name = axis_name or self.axis_name
-        total = jax.lax.psum(x, name)
+        total = _guarded("comm.reduce", jax.lax.psum, x, name)
         idx = jax.lax.axis_index(name)
         return jnp.where(idx == root, total, jnp.zeros_like(total))
 
@@ -538,7 +569,7 @@ class MeshCommunication(Communication):
         if diagnostics._enabled:
             self._record_collective("gather", axis_name, x)
         name = axis_name or self.axis_name
-        full = jax.lax.all_gather(x, name, axis=axis, tiled=True)
+        full = _guarded("comm.gather", jax.lax.all_gather, x, name, axis=axis, tiled=True)
         idx = jax.lax.axis_index(name)
         return jnp.where(idx == root, full, jnp.zeros_like(full))
 
@@ -627,7 +658,7 @@ def _pad_reshard(
 
             fn = jax.jit(_pad, out_shardings=target)
         _pad_cache[key] = fn
-    return fn(array)
+    return _guarded("comm.reshard", fn, array)
 
 
 # --------------------------------------------------------------------------- singletons

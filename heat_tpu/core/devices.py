@@ -224,13 +224,42 @@ def _write_caps_cache(caps: dict, probe_ok: bool) -> None:
         pass  # cache is best-effort; the in-process memo still holds
 
 
+def relay_breaker():
+    """The per-process circuit breaker every backend/relay probe feeds
+    (``resilience.relay_breaker`` — config centralised there): while open,
+    capability probes short-circuit to the conservative negative verdict
+    instead of re-paying the 90 s subprocess timeout; after the cooldown it
+    half-opens and the next probe is a real trial."""
+    from . import resilience
+
+    return resilience.relay_breaker()
+
+
 def _probe_caps_subprocess() -> tuple:
     """Returns ``(caps, probe_ok)``: ``probe_ok`` is True when the child actually ran
     the probe (its verdict — positive or negative — is a stable hardware fact) and
     False when the child itself failed (timeout, init failure), i.e. the conservative
-    all-False answer is a guess."""
+    all-False answer is a guess.
+
+    The probe honors (and feeds) the ``backend.relay`` circuit breaker: an open
+    breaker short-circuits straight to the negative guess — the 90 s child
+    timeout is paid at most ``failure_threshold`` times per process, and again
+    only when the breaker half-opens for a re-probe."""
     import subprocess
     import sys
+
+    from . import resilience
+
+    breaker = relay_breaker()
+    if resilience._armed:
+        entry = resilience.fault_signal("probe.caps")
+        if entry is not None:
+            # injected relay failure: same negative verdict + breaker feedback
+            # a real dead relay would produce, with zero wall-clock cost
+            breaker.record_failure(f"injected {entry.kind}")
+            return {"complex": False, "fft": False}, False
+    if not breaker.allows():
+        return {"complex": False, "fft": False}, False
 
     # the child must land on the SAME accelerator platform as the parent —
     # on exclusively-locked devices it may fail to initialize (or silently
@@ -258,10 +287,13 @@ def _probe_caps_subprocess() -> tuple:
             (l for l in proc.stdout.splitlines() if l.startswith("CAPS")), None
         )
         if line is None:
+            breaker.record_failure(f"caps probe child rc={proc.returncode}, no verdict")
             return {"complex": False, "fft": False}, False
         _, c, f = line.split()
+        breaker.record_success()
         return {"complex": bool(int(c)), "fft": bool(int(f))}, True
-    except Exception:
+    except Exception as exc:
+        breaker.record_failure(f"caps probe child failed: {type(exc).__name__}")
         return {"complex": False, "fft": False}, False
 
 

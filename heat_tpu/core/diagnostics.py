@@ -82,6 +82,8 @@ __all__ = [
     "record_collective",
     "record_compile",
     "record_dispatch_event",
+    "record_fallback",
+    "record_resilience_event",
     "record_pad_waste",
     "record_backend_event",
     "relay_outage_windows",
@@ -109,6 +111,8 @@ _collectives: Dict[Any, Dict[str, int]] = {}
 _pad_gauges: Dict[Any, Dict[str, Any]] = {}
 _compile_events: "deque[dict]" = deque(maxlen=_MAX_EVENTS)
 _dispatch_events: "deque[dict]" = deque(maxlen=_MAX_EVENTS)
+_fallback_events: "deque[dict]" = deque(maxlen=_MAX_EVENTS)
+_resilience_events: "deque[dict]" = deque(maxlen=_MAX_EVENTS)
 _backend_events: "deque[dict]" = deque(maxlen=_MAX_EVENTS)
 _backend_state: Optional[bool] = None
 
@@ -172,6 +176,8 @@ def reset() -> None:
         _pad_gauges.clear()
         _compile_events.clear()
         _dispatch_events.clear()
+        _fallback_events.clear()
+        _resilience_events.clear()
         _backend_events.clear()
 
 
@@ -245,6 +251,31 @@ def record_dispatch_event(kind: str, label: str, reason: str) -> None:
     rec = {"t": _utcnow(), "kind": kind, "label": label, "reason": reason}
     with _lock:
         _dispatch_events.append(rec)
+
+
+def record_fallback(site: str, reason: str) -> None:
+    """One eager-path fallback that used to be a silent ``except Exception``:
+    counted per site (``fallback.<site>``) and recorded with its reason
+    (exception type + op label), so a workload that quietly lost its staged
+    programs is visible in the report instead of just slow."""
+    if not _enabled:
+        return
+    rec = {"t": _utcnow(), "site": site, "reason": str(reason)}
+    with _lock:
+        _counters[f"fallback.{site}"] = _counters.get(f"fallback.{site}", 0) + 1
+        _fallback_events.append(rec)
+
+
+def record_resilience_event(site: str, kind: str, detail: str = "") -> None:
+    """A resilience-subsystem event: policy ``retry``/``exhausted``, circuit
+    ``breaker`` transitions, injected ``fault`` firings, executor ``fallback``
+    and quarantine decisions. Always on (not gated by :func:`enabled`), like
+    backend-health events: these come from explicit failure-path machinery,
+    never from a hot compute path, and a null round must stay attributable
+    even when metrics were off."""
+    rec = {"t": _utcnow(), "site": site, "kind": kind, "detail": str(detail)}
+    with _lock:
+        _resilience_events.append(rec)
 
 
 def record_pad_waste(gshape, split: int, padded_dim: int) -> None:
@@ -354,6 +385,8 @@ def report() -> dict:
             ],
             "compile_events": list(_compile_events),
             "dispatch_events": list(_dispatch_events),
+            "fallback_events": list(_fallback_events),
+            "resilience_events": list(_resilience_events),
             "backend_events": list(_backend_events),
         }
     rep["relay_outage_windows"] = relay_outage_windows(rep["backend_events"])

@@ -17,10 +17,30 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from . import factories, types
+from . import factories, resilience, types
 from .communication import sanitize_comm
 from .devices import sanitize_device
 from .dndarray import DNDarray
+
+
+# In-place writes (HDF5 append, NetCDF) are NOT idempotent: a half-applied
+# attempt followed by a blind replay would duplicate appends or trip over the
+# already-created dataset, masking the real error. They therefore run
+# single-attempt by default — injected faults still fire (and surface), and an
+# operator can opt a site into retries with resilience.set_policy, owning the
+# idempotency question. Whole-file mode='w' saves go through
+# resilience.atomic_write instead (temp + fsync + rename, safely retried).
+_SINGLE_ATTEMPT = resilience.Policy(max_attempts=1)
+
+
+def _guarded_write(site: str, fn, *args, **kwargs):
+    """Run an in-place file write under ht.resilience when a fault plan is
+    armed or a site policy is registered (same idle fast path as the
+    communication layer); see the single-attempt note above."""
+    if resilience._active:
+        policy = resilience.site_policy(site) or _SINGLE_ATTEMPT
+        return resilience.guard(site, fn, *args, policy=policy, **kwargs)
+    return fn(*args, **kwargs)
 
 __all__ = [
     "load",
@@ -312,13 +332,24 @@ if _HAS_HDF5:
 
             _serialized_shard_write(f"save_hdf5:{path}", write_my_shards)
             return
-        with h5py.File(path, mode) as handle:
-            dset = handle.create_dataset(dataset, data.gshape, dtype=np_dtype, **kwargs)
-            if data.split is None:
-                dset[...] = np.asarray(data.larray)
-            else:
-                for index, value in data.iter_shards():
-                    dset[index] = np.asarray(value)
+        def write_file(target_path: str, file_mode: str) -> None:
+            with h5py.File(target_path, file_mode) as handle:
+                dset = handle.create_dataset(dataset, data.gshape, dtype=np_dtype, **kwargs)
+                if data.split is None:
+                    dset[...] = np.asarray(data.larray)
+                else:
+                    for index, value in data.iter_shards():
+                        dset[index] = np.asarray(value)
+
+        if mode == "w":
+            # whole-file write: assembled at a temp path and committed with one
+            # rename, retried under the io.save_hdf5 policy — a crashed or
+            # injected-fault save never leaves a torn .h5 behind
+            resilience.atomic_write(
+                path, lambda tmp: write_file(tmp, "w"), site="io.save_hdf5"
+            )
+        else:
+            _guarded_write("io.save_hdf5", write_file, path, mode)
 
 
 def _netcdf_has_fancy_keys(file_slices) -> bool:
@@ -513,27 +544,30 @@ if _HAS_NETCDF:
             _serialized_shard_write(f"save_netcdf:{path}", write_my_shards)
             return
 
-        with nc.Dataset(path, mode) as handle:
-            var = _ensure_variable(handle)
-            unlimited = [handle.dimensions[d].isunlimited() for d in var.dimensions]
-            ranges = _compose_netcdf_slices(file_slices, data.gshape, var.shape, unlimited)
-            if fancy or len(data.gshape) != len(var.shape):
-                # fancy keys or netCDF broadcast across a dim-count mismatch:
-                # one whole-variable write of the logical value
-                var[file_slices] = data.numpy()
-            elif ranges is None:
-                # plain slices that don't address the data: same error as the
-                # multi-controller path (never a silent broadcast)
-                raise ValueError(
-                    f"file_slices {file_slices!r} do not address the data extent "
-                    f"{data.gshape} within the variable's dimensions"
-                )
-            elif data.split is None:
-                var[tuple(slice(r.start, r.stop, r.step) for r in ranges)] = (
-                    np.asarray(data.larray)
-                )
-            else:
-                _shard_writes(handle, ranges)
+        def write_single_controller() -> None:
+            with nc.Dataset(path, mode) as handle:
+                var = _ensure_variable(handle)
+                unlimited = [handle.dimensions[d].isunlimited() for d in var.dimensions]
+                ranges = _compose_netcdf_slices(file_slices, data.gshape, var.shape, unlimited)
+                if fancy or len(data.gshape) != len(var.shape):
+                    # fancy keys or netCDF broadcast across a dim-count mismatch:
+                    # one whole-variable write of the logical value
+                    var[file_slices] = data.numpy()
+                elif ranges is None:
+                    # plain slices that don't address the data: same error as the
+                    # multi-controller path (never a silent broadcast)
+                    raise ValueError(
+                        f"file_slices {file_slices!r} do not address the data extent "
+                        f"{data.gshape} within the variable's dimensions"
+                    )
+                elif data.split is None:
+                    var[tuple(slice(r.start, r.stop, r.step) for r in ranges)] = (
+                        np.asarray(data.larray)
+                    )
+                else:
+                    _shard_writes(handle, ranges)
+
+        _guarded_write("io.save_netcdf", write_single_controller)
 
 
 def load_csv(
@@ -641,7 +675,14 @@ def save_csv(
         else:
             fmt = "%.18e"
         header = "\n".join(header_lines) if header_lines else ""
-        np.savetxt(path, arr.reshape(arr.shape[0], -1), delimiter=sep, fmt=fmt, header=header, comments="")
+        resilience.atomic_write(
+            path,
+            lambda tmp: np.savetxt(
+                tmp, arr.reshape(arr.shape[0], -1), delimiter=sep, fmt=fmt,
+                header=header, comments="",
+            ),
+            site="io.save_csv",
+        )
     _writer_barrier(f"save_csv:{path}")
 
 
@@ -652,10 +693,17 @@ def load_npy(path: str, dtype=None, split: Optional[int] = None, device=None, co
 
 
 def save_npy(data: DNDarray, path: str) -> None:
-    """Save to a .npy file."""
+    """Save to a .npy file (atomic: temp + fsync + rename, policy-retried)."""
     arr = data.numpy()
     if _is_writer():
-        np.save(path, arr)
+
+        def write(tmp: str) -> None:
+            # np.save(path) would append ".npy" to the temp name; write the
+            # stream through an explicit handle so the rename target is exact
+            with open(tmp, "wb") as fh:
+                np.save(fh, arr)
+
+        resilience.atomic_write(path, write, site="io.save_npy")
     _writer_barrier(f"save_npy:{path}")
 
 

@@ -175,21 +175,14 @@ def main() -> None:
         pred = np.argmax(dp(ht.array(xb, split=0)).numpy(), axis=1)
         assert (pred == yb).mean() > 0.9, (pred == yb).mean()
 
-    # --- orbax sharded checkpoint: each process streams only its own shards ----
-    try:
-        import orbax.checkpoint  # noqa: F401
-
-        has_orbax = True
-    except ImportError:
-        has_orbax = False
-    if has_orbax:
-        ckpt_dir = os.path.join(tmpdir, "ckpt")
-        ht.save_checkpoint({"a": a}, ckpt_dir)
-        restored = ht.load_checkpoint(
-            {"a": ht.zeros(tuple(a.gshape), split=0)}, ckpt_dir
-        )
-        np.testing.assert_allclose(restored["a"].numpy(), global_ref)
-        assert restored["a"].split == 0
+    # --- native atomic checkpoint: process 0 commits, every process restores ----
+    ckpt_dir = os.path.join(tmpdir, "ckpt")
+    ht.save_checkpoint({"a": a}, ckpt_dir)
+    restored = ht.load_checkpoint(
+        {"a": ht.zeros(tuple(a.gshape), split=0)}, ckpt_dir
+    )
+    np.testing.assert_allclose(restored["a"].numpy(), global_ref)
+    assert restored["a"].split == 0
 
     print(f"WORKER_OK {pid}", flush=True)
 

@@ -1,6 +1,8 @@
 """Checkpoint/resume tests (SURVEY §5: the reference has only data-level I/O; this is
-the training-state checkpointing the TPU build adds via orbax/tensorstore)."""
+the training-state checkpointing the TPU build adds — a native manifest-backed
+atomic format since ISSUE 6, with torn-write detection and policy-driven retry)."""
 
+import json
 import os
 import shutil
 import tempfile
@@ -9,9 +11,9 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+from heat_tpu.core import checkpoint as _ckpt
+from heat_tpu.core import resilience
 from heat_tpu.testing import TestCase
-
-pytest.importorskip("orbax.checkpoint")
 
 
 class TestCheckpoint(TestCase):
@@ -102,6 +104,161 @@ class TestCheckpoint(TestCase):
 
         resumed = [float(opt2.step(loss_fn2, x, y)) for _ in range(2)]
         np.testing.assert_allclose(resumed, continued, rtol=1e-6)
+
+
+class TestCheckpointIntegrity(TestCase):
+    """ISSUE 6 satellite: torn-write → restore-rejects-and-reports, and
+    ``latest_step()`` over a corrupt step directory."""
+
+    def setUp(self):
+        self.tmp = tempfile.mkdtemp()
+        resilience.disarm_fault_plan()
+        resilience.reset()
+
+    def tearDown(self):
+        shutil.rmtree(self.tmp, ignore_errors=True)
+        resilience.disarm_fault_plan()
+        resilience.reset()
+
+    def _save(self, name, value):
+        path = os.path.join(self.tmp, name)
+        ht.save_checkpoint({"x": ht.array(value, split=0)}, path)
+        return path
+
+    def test_manifest_is_written_and_verifies(self):
+        value = np.arange(20, dtype=np.float32)
+        path = self._save("ok", value)
+        manifest = _ckpt.read_manifest(path)
+        self.assertEqual(manifest["schema"], _ckpt.SCHEMA)
+        self.assertEqual(len(manifest["leaves"]), 1)
+        self.assertEqual(manifest["leaves"][0]["nbytes"], value.nbytes)
+        self.assertEqual(_ckpt.verify_checkpoint(path), [])
+
+    def test_torn_write_restore_rejects_and_reports(self):
+        # the injected torn-write truncates the committed payload while the
+        # manifest keeps the intended digest — exactly a partial write
+        resilience.arm_fault_plan(
+            [{"site": "checkpoint.write", "on_call": 1, "kind": "torn-write",
+              "fraction": 0.5}]
+        )
+        path = self._save("torn", np.arange(32, dtype=np.float32))
+        resilience.disarm_fault_plan()
+        problems = _ckpt.verify_checkpoint(path)
+        self.assertEqual(len(problems), 1)
+        self.assertIn("torn write", problems[0])
+        with self.assertRaises(ht.CheckpointCorrupt) as ctx:
+            ht.load_checkpoint({"x": ht.zeros((32,), split=0)}, path)
+        self.assertIn("leaf_0.bin", str(ctx.exception))
+        self.assertIn("torn write", str(ctx.exception))
+
+    def test_hand_truncated_file_detected(self):
+        value = np.arange(16, dtype=np.float32)
+        path = self._save("trunc", value)
+        leaf = os.path.join(path, "leaf_0.bin")
+        with open(leaf, "r+b") as fh:
+            fh.truncate(value.nbytes // 2)
+        with self.assertRaises(ht.CheckpointCorrupt):
+            ht.load_checkpoint({"x": ht.zeros((16,), split=0)}, path)
+
+    def test_bitflip_detected_by_digest(self):
+        value = np.arange(16, dtype=np.float32)
+        path = self._save("flip", value)
+        leaf = os.path.join(path, "leaf_0.bin")
+        with open(leaf, "r+b") as fh:
+            fh.seek(3)
+            byte = fh.read(1)
+            fh.seek(3)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+        problems = _ckpt.verify_checkpoint(path)
+        self.assertTrue(any("sha256 mismatch" in p for p in problems), problems)
+        with self.assertRaises(ht.CheckpointCorrupt):
+            ht.load_checkpoint({"x": ht.zeros((16,), split=0)}, path)
+
+    def test_missing_manifest_is_corrupt_not_crash(self):
+        path = os.path.join(self.tmp, "empty")
+        os.makedirs(path)
+        with self.assertRaises(ht.CheckpointCorrupt) as ctx:
+            ht.load_checkpoint({"x": ht.zeros(3)}, path)
+        self.assertIn("manifest.json missing", str(ctx.exception))
+
+    def test_write_fault_retried_under_policy(self):
+        resilience.arm_fault_plan(
+            [{"site": "checkpoint.write", "on_call": 1, "count": 2, "kind": "raise"}]
+        )
+        value = np.arange(12, dtype=np.float32)
+        path = self._save("retried", value)  # two injected failures, third lands
+        back = ht.load_checkpoint({"x": ht.zeros((12,), split=0)}, path)
+        self.assert_array_equal(back["x"], value)
+
+    def test_latest_step_skips_corrupt_step_directory(self):
+        mgr = ht.CheckpointManager(os.path.join(self.tmp, "run"), max_to_keep=5)
+        x = ht.arange(12, dtype=ht.float32, split=0)
+        for s in (1, 2, 3):
+            mgr.save(s, {"x": x * float(s)})
+        # corrupt step 3 the way a torn dir-commit / partial delete would:
+        # manifest gone → the step no longer counts as restorable
+        os.unlink(os.path.join(self.tmp, "run", "step_3", "manifest.json"))
+        self.assertEqual(mgr.all_steps(), [1, 2])
+        self.assertEqual(mgr.latest_step, 2)
+        r = mgr.restore({"x": ht.zeros((12,), split=0)})
+        self.assert_array_equal(r["x"], (x * 2.0).numpy())
+        # unparseable manifest is equally corrupt, equally skipped
+        with open(os.path.join(self.tmp, "run", "step_2", "manifest.json"), "w") as fh:
+            fh.write("{not json")
+        self.assertEqual(mgr.all_steps(), [1])
+        self.assertEqual(mgr.latest_step, 1)
+        # a torn leaf UNDER an intact manifest still enumerates (cheap scan)
+        # but refuses the actual restore with the per-file report
+        leaf = os.path.join(self.tmp, "run", "step_1", "leaf_0.bin")
+        with open(leaf, "r+b") as fh:
+            fh.truncate(4)
+        self.assertEqual(mgr.all_steps(), [1])
+        with self.assertRaises(ht.CheckpointCorrupt):
+            mgr.restore({"x": ht.zeros((12,), split=0)}, step=1)
+        mgr.close()
+
+    def test_retention_gcs_corrupt_step_dirs(self):
+        mgr = ht.CheckpointManager(os.path.join(self.tmp, "gc"), max_to_keep=2)
+        x = ht.arange(6, dtype=ht.float32, split=0)
+        mgr.save(1, {"x": x})
+        # corrupt step 1: it stops counting toward retention AND must not
+        # leak on disk forever — the next save garbage-collects it
+        os.unlink(os.path.join(self.tmp, "gc", "step_1", "manifest.json"))
+        mgr.save(2, {"x": x * 2.0})
+        self.assertFalse(os.path.exists(os.path.join(self.tmp, "gc", "step_1")))
+        self.assertEqual(mgr.all_steps(), [2])
+        mgr.close()
+
+    def test_stale_tmp_and_old_dirs_swept_by_next_save(self):
+        value = np.arange(8, dtype=np.float32)
+        path = self._save("sweep", value)
+        # fake a crash from ANOTHER pid mid-commit: the previous checkpoint is
+        # stranded at .old.<pid>, a half-built .tmp.<pid> remains, the target
+        # is gone — the next save must recover, sweep, and commit cleanly
+        os.rename(path, path + ".old.999999")
+        os.makedirs(path + ".tmp.999999")
+        ht.save_checkpoint({"x": ht.array(value * 2.0, split=0)}, path)
+        self.assertFalse(os.path.exists(path + ".old.999999"))
+        self.assertFalse(os.path.exists(path + ".tmp.999999"))
+        back = ht.load_checkpoint({"x": ht.zeros((8,), split=0)}, path)
+        self.assert_array_equal(back["x"], value * 2.0)
+        self.assertEqual(_ckpt.verify_checkpoint(path), [])
+
+    def test_save_is_atomic_under_midwrite_crash(self):
+        """A save that dies before the manifest commit must leave the previous
+        checkpoint fully intact (the temp-dir assembly is invisible)."""
+        value = np.arange(8, dtype=np.float32)
+        path = self._save("atomic", value)
+        resilience.arm_fault_plan(
+            [{"site": "checkpoint.manifest", "on_call": 1, "count": 999, "kind": "raise"}]
+        )
+        with self.assertRaises(resilience.FaultInjected):
+            ht.save_checkpoint({"x": ht.array(value * 9.0, split=0)}, path)
+        resilience.disarm_fault_plan()
+        # the failed save never committed: the old bits restore bit-identically
+        back = ht.load_checkpoint({"x": ht.zeros((8,), split=0)}, path)
+        self.assert_array_equal(back["x"], value)
+        self.assertEqual(_ckpt.verify_checkpoint(path), [])
 
 
 if __name__ == "__main__":

@@ -1,0 +1,674 @@
+"""Chaos / resilience suite (ISSUE 6 tentpole).
+
+Injects deterministic faults (``HEAT_TPU_FAULT_PLAN`` semantics via
+``resilience.arm_fault_plan``) at the five instrumented site families —
+collective invocation, executor compile, executor execute (including the
+donation-armed case), checkpoint writes, and relay probes — and asserts:
+
+- recovery is **bit-identical** to the fault-free run (retry or eager fallback,
+  never silently different numerics);
+- the diagnostics counters/events explain what happened (retries, fallbacks,
+  breaker transitions, quarantines);
+- compiled HLO is **byte-identical** whether or not a fault plan is armed
+  (the resilience layer lives strictly outside traced program bodies);
+- the policy engine and circuit breaker follow their documented state machines
+  under injectable clocks (zero wall-time in tests).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import types as _pytypes
+import unittest.mock as mock
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import _executor, devices, diagnostics, resilience
+from heat_tpu.testing import TestCase
+
+_OLD_THRESHOLD = None
+
+
+def setUpModule():
+    # chaos tests assert the production compile-on-first-miss behaviour (the
+    # suite conftest raises the warm-up threshold for signature-diverse tests)
+    global _OLD_THRESHOLD
+    _OLD_THRESHOLD = os.environ.get("HEAT_TPU_JIT_THRESHOLD")
+    os.environ["HEAT_TPU_JIT_THRESHOLD"] = "1"
+
+
+def tearDownModule():
+    if _OLD_THRESHOLD is None:
+        os.environ.pop("HEAT_TPU_JIT_THRESHOLD", None)
+    else:
+        os.environ["HEAT_TPU_JIT_THRESHOLD"] = _OLD_THRESHOLD
+
+
+class _ResilienceCase(TestCase):
+    """Isolation: every test starts disarmed with fresh counters/breakers and
+    restores the diagnostics switches it flips."""
+
+    def setUp(self):
+        resilience.disarm_fault_plan()
+        resilience.reset(clear_breakers=True)
+        self._was_enabled = diagnostics._enabled
+        self._was_tracing = diagnostics._tracing
+        diagnostics.reset()
+
+    def tearDown(self):
+        resilience.disarm_fault_plan()
+        resilience.reset(clear_breakers=True)
+        diagnostics._enabled = self._was_enabled
+        diagnostics._tracing = self._was_tracing
+
+    @staticmethod
+    def _counters():
+        with diagnostics._lock:
+            return dict(diagnostics._counters)
+
+    @staticmethod
+    def _resilience_events():
+        with diagnostics._lock:
+            return list(diagnostics._resilience_events)
+
+
+class _FakeClock:
+    def __init__(self, t0=0.0):
+        self.t = t0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------------------ policy engine
+class TestPolicy(_ResilienceCase):
+    def test_backoff_sequence_is_deterministic(self):
+        pol = resilience.Policy(max_attempts=5, backoff_base=0.5, jitter=0.0)
+        sleeps = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 4:
+                raise OSError("transient")
+            return "ok"
+
+        out = pol.run("t.backoff", flaky, sleep=sleeps.append)
+        self.assertEqual(out, "ok")
+        self.assertEqual(calls["n"], 4)
+        self.assertEqual(sleeps, [0.5, 1.0, 2.0])
+
+    def test_exhaustion_reraises_the_original_exception(self):
+        pol = resilience.Policy(max_attempts=3, backoff_base=0.1, jitter=0.0)
+        sleeps = []
+        with self.assertRaisesRegex(ValueError, "boom"):
+            pol.run(
+                "t.exhaust",
+                lambda: (_ for _ in ()).throw(ValueError("boom")),
+                sleep=sleeps.append,
+            )
+        self.assertEqual(sleeps, [0.1, 0.2])  # no sleep after the final attempt
+        kinds = [e["kind"] for e in self._resilience_events() if e["site"] == "t.exhaust"]
+        self.assertEqual(kinds, ["retry", "retry", "exhausted"])
+
+    def test_deadline_bounds_unlimited_attempts(self):
+        pol = resilience.Policy(
+            max_attempts=None, backoff_base=10.0, jitter=0.0,
+            deadline_s=35.0, max_delay_s=10.0,
+        )
+        clock = _FakeClock()
+        calls = {"n": 0}
+
+        def always_down():
+            calls["n"] += 1
+            raise TimeoutError("down")
+
+        with self.assertRaises(TimeoutError):
+            pol.run("t.deadline", always_down, sleep=clock.sleep, clock=clock)
+        # attempts at t=0, 10, 20, 30; the next backoff would cross 35 s
+        self.assertEqual(calls["n"], 4)
+
+    def test_non_retryable_exception_propagates_immediately(self):
+        pol = resilience.Policy(max_attempts=5, backoff_base=0.1,
+                                retry_on=(OSError,))
+        calls = {"n": 0}
+
+        def typed():
+            calls["n"] += 1
+            raise KeyError("not retryable")
+
+        with self.assertRaises(KeyError):
+            pol.run("t.typed", typed, sleep=lambda _s: None)
+        self.assertEqual(calls["n"], 1)
+
+    def test_unbounded_without_deadline_is_rejected(self):
+        with self.assertRaises(ValueError):
+            resilience.Policy(max_attempts=None)
+
+
+# ------------------------------------------------------------------ circuit breaker
+class TestCircuitBreaker(_ResilienceCase):
+    def test_state_machine(self):
+        clock = _FakeClock()
+        br = resilience.CircuitBreaker(
+            "t.breaker", failure_threshold=2, cooldown_s=60.0, clock=clock
+        )
+        self.assertEqual(br.state, resilience.CLOSED)
+        br.record_failure("one")
+        self.assertEqual(br.state, resilience.CLOSED)
+        br.record_failure("two")
+        self.assertEqual(br.state, resilience.OPEN)
+        self.assertFalse(br.allows())  # short-circuit while open
+        clock.t += 61.0
+        self.assertEqual(br.state, resilience.HALF_OPEN)
+        self.assertTrue(br.allows())  # the half-open trial
+        br.record_failure("trial failed")
+        self.assertEqual(br.state, resilience.OPEN)  # re-open restarts cooldown
+        clock.t += 61.0
+        self.assertTrue(br.allows())
+        br.record_success()
+        self.assertEqual(br.state, resilience.CLOSED)
+        self.assertEqual(br.snapshot()["opens"], 2)
+
+    def test_transitions_recorded_via_diagnostics(self):
+        clock = _FakeClock()
+        br = resilience.CircuitBreaker("t.events", failure_threshold=1,
+                                       cooldown_s=5.0, clock=clock)
+        br.record_failure("down")
+        clock.t += 6.0
+        br.allows()
+        br.record_success()
+        details = [
+            e["detail"] for e in self._resilience_events()
+            if e["site"] == "t.events" and e["kind"] == "breaker"
+        ]
+        self.assertTrue(any(d.startswith("closed->open") for d in details), details)
+        self.assertTrue(any(d.startswith("open->half-open") for d in details), details)
+        self.assertTrue(any(d.startswith("half-open->closed") for d in details), details)
+
+    def test_success_resets_consecutive_failures(self):
+        br = resilience.CircuitBreaker("t.reset", failure_threshold=2)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        self.assertEqual(br.state, resilience.CLOSED)
+
+
+# ------------------------------------------------------------------ fault plans
+class TestFaultPlan(_ResilienceCase):
+    def test_fires_on_exact_nth_call_window(self):
+        resilience.arm_fault_plan(
+            [{"site": "t.site", "on_call": 3, "count": 2, "kind": "raise"}]
+        )
+        fired = []
+        for _ in range(6):
+            fired.append(resilience.fault_signal("t.site") is not None)
+        self.assertEqual(fired, [False, False, True, True, False, False])
+
+    def test_kinds_raise_their_exception_types(self):
+        resilience.arm_fault_plan(
+            [
+                {"site": "t.raise", "kind": "raise"},
+                {"site": "t.timeout", "kind": "timeout"},
+                {"site": "t.down", "kind": "backend-down"},
+            ]
+        )
+        with self.assertRaises(resilience.FaultInjected):
+            resilience.maybe_fault("t.raise")
+        with self.assertRaises(TimeoutError):  # InjectedTimeout is a TimeoutError
+            resilience.maybe_fault("t.timeout")
+        with self.assertRaises(resilience.InjectedBackendDown):
+            resilience.maybe_fault("t.down")
+
+    def test_disarm_restores_zero_cost_gate(self):
+        resilience.arm_fault_plan([{"site": "t.site", "kind": "raise"}])
+        self.assertTrue(resilience._armed)
+        resilience.disarm_fault_plan()
+        self.assertFalse(resilience._armed)
+        self.assertIsNone(resilience.fault_signal("t.site"))
+        self.assertEqual(resilience.fault_plan(), [])
+
+    def test_json_string_and_validation(self):
+        resilience.arm_fault_plan(
+            '[{"site": "t.json", "on_call": 2, "kind": "torn-write", "fraction": 0.25}]'
+        )
+        plan = resilience.fault_plan()
+        self.assertEqual(plan[0]["site"], "t.json")
+        self.assertEqual(plan[0]["fraction"], 0.25)
+        for bad in (
+            "not json",
+            '{"site": "x"}',  # not a list
+            '[{"kind": "raise"}]',  # no site
+            '[{"site": "x", "kind": "nope"}]',  # unknown kind
+            '[{"site": "x", "on_call": 0}]',  # on_call < 1
+            '[{"site": "x", "typo": 1}]',  # unknown key
+        ):
+            with self.assertRaises(ValueError):
+                resilience.arm_fault_plan(bad)
+
+    def test_env_plan_arms_at_import(self):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            HEAT_TPU_FAULT_PLAN='[{"site": "e.site", "on_call": 5, "kind": "timeout"}]',
+        )
+        code = (
+            "import importlib.util, os\n"
+            "p = os.path.join(%r, 'heat_tpu', 'core', 'resilience.py')\n"
+            "spec = importlib.util.spec_from_file_location('_r', p)\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "assert m._armed and m.fault_plan()[0]['site'] == 'e.site'\n"
+            "print('ENV_PLAN_OK')\n"
+        ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+            timeout=120,
+        )
+        self.assertEqual(proc.returncode, 0, proc.stderr[-500:])
+        self.assertIn("ENV_PLAN_OK", proc.stdout)
+
+
+# ------------------------------------------------------------------ chaos: collectives
+class TestChaosCollective(_ResilienceCase):
+    def test_shard_fault_retried_bit_identically(self):
+        np_a = np.arange(10, dtype=np.float32)  # ragged at 3 and 8 devices
+        baseline = ht.array(np_a, split=0)
+        diagnostics.enable()
+        resilience.arm_fault_plan(
+            [{"site": "comm.shard", "on_call": 1, "kind": "raise"}]
+        )
+        x = ht.array(np_a, split=0)  # the layout call absorbs the injected fault
+        np.testing.assert_array_equal(x.numpy(), baseline.numpy())
+        self.assertGreaterEqual(self._counters().get("resilience.retry.comm.shard", 0), 1)
+
+    def test_psum_fault_retried_inside_shard_map(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        comm = ht.get_comm()
+        x = jnp.arange(comm.size, dtype=jnp.float32) + 1.0
+
+        def total():
+            # a fresh callable per run so shard_map re-traces (the collective
+            # hook — and therefore the fault site — runs at trace time)
+            fn = shard_map(
+                lambda v: comm.psum(v, comm.axis_name),
+                mesh=comm.mesh,
+                in_specs=P(comm.axis_name),
+                out_specs=P(),
+            )
+            return np.asarray(fn(x))
+
+        expected = total()
+        diagnostics.enable()
+        resilience.arm_fault_plan(
+            [{"site": "comm.psum", "on_call": 1, "kind": "timeout"}]
+        )
+        np.testing.assert_array_equal(total(), expected)
+        self.assertGreaterEqual(self._counters().get("resilience.retry.comm.psum", 0), 1)
+
+
+# ------------------------------------------------------------------ chaos: executor
+class TestChaosExecutor(_ResilienceCase):
+    def _chain(self, np_a):
+        x = ht.array(np_a, split=0)
+        return ((x + 1.0) * 2.0 - 0.5).numpy()
+
+    def test_compile_fault_falls_back_to_eager_bit_identically(self):
+        np_a = np.linspace(0.0, 1.0, 11, dtype=np.float32)
+        expected = (np_a + 1.0) * 2.0 - 0.5
+        _executor.clear_executor_cache()
+        diagnostics.enable()
+        resilience.arm_fault_plan(
+            [{"site": "executor.compile", "on_call": 1, "count": 99, "kind": "raise"}]
+        )
+        got = self._chain(np_a)
+        np.testing.assert_array_equal(got, expected)
+        stats = ht.executor_stats()
+        self.assertGreaterEqual(stats["eager_fallbacks"], 1)
+        self.assertTrue(
+            any(c.startswith("fallback.executor.") for c in self._counters()),
+            self._counters(),
+        )
+
+    def test_transient_execute_fault_recovers_via_retry(self):
+        np_a = np.linspace(-1.0, 1.0, 9, dtype=np.float32)
+        expected = (np_a + 1.0) * 2.0 - 0.5
+        _executor.clear_executor_cache()
+        diagnostics.enable()
+        resilience.arm_fault_plan(
+            [{"site": "executor.execute", "on_call": 1, "count": 1, "kind": "raise"}]
+        )
+        got = self._chain(np_a)
+        np.testing.assert_array_equal(got, expected)
+        stats = ht.executor_stats()
+        # one retry absorbed the fault: the compiled program ran, no fallback
+        self.assertEqual(stats["eager_fallbacks"], 0)
+        self.assertGreaterEqual(
+            self._counters().get("resilience.retry.executor.execute", 0), 1
+        )
+
+    def test_execute_fault_with_pending_donation_no_data_loss(self):
+        np_a = np.arange(16, dtype=np.float32)
+        _executor.clear_executor_cache()
+        resilience.arm_fault_plan(
+            [{"site": "executor.execute", "on_call": 1, "count": 99, "kind": "raise"}]
+        )
+        x = ht.array(np_a, split=0)
+        y = x * 2.0
+        del x  # the plan becomes the leaf's sole reader: donation is armed
+        np.testing.assert_array_equal(y.numpy(), np_a * 2.0)
+        stats = ht.executor_stats()
+        self.assertGreaterEqual(stats["eager_fallbacks"], 1)
+        # the injected failure struck before dispatch: nothing was donated, the
+        # eager replay read live buffers — zero bytes counted as donated
+        self.assertEqual(stats["donated_bytes"], 0)
+
+    def test_repeated_failures_quarantine_with_explained_reason(self):
+        np_a = np.arange(12, dtype=np.float32)
+        _executor.clear_executor_cache()
+        os.environ["HEAT_TPU_QUARANTINE_AFTER"] = "3"
+        try:
+            resilience.arm_fault_plan(
+                [{"site": "executor.execute", "on_call": 1, "count": 9999, "kind": "raise"}]
+            )
+            for i in range(4):
+                x = ht.array(np_a + i, split=0)
+                y = (x + 1.0) * 3.0
+                np.testing.assert_array_equal(y.numpy(), (np_a + i + 1.0) * 3.0)
+            stats = ht.executor_stats()
+            self.assertGreaterEqual(stats["eager_fallbacks"], 3)
+            self.assertTrue(stats["quarantined"], stats)
+            label, reason = next(iter(stats["quarantined"].items()))
+            self.assertIn("FaultInjected", reason)
+            self.assertIn("failure 3", reason)
+        finally:
+            os.environ.pop("HEAT_TPU_QUARANTINE_AFTER", None)
+        # quarantined: later identical dispatches take the eager path and stay correct
+        x = ht.array(np_a, split=0)
+        np.testing.assert_array_equal(((x + 1.0) * 3.0).numpy(), (np_a + 1.0) * 3.0)
+
+
+# ------------------------------------------------------------------ chaos: checkpoint
+class TestChaosCheckpoint(_ResilienceCase):
+    def setUp(self):
+        super().setUp()
+        import tempfile
+
+        self.tmp = tempfile.mkdtemp()
+
+    def tearDown(self):
+        import shutil
+
+        shutil.rmtree(self.tmp, ignore_errors=True)
+        super().tearDown()
+
+    def test_transient_write_fault_retried_roundtrip_identical(self):
+        diagnostics.enable()
+        x = ht.array(np.arange(20, dtype=np.float32).reshape(4, 5), split=0)
+        resilience.arm_fault_plan(
+            [{"site": "checkpoint.write", "on_call": 1, "count": 1, "kind": "raise"}]
+        )
+        path = os.path.join(self.tmp, "ckpt")
+        ht.save_checkpoint({"x": x}, path)  # attempt 1 injected, attempt 2 lands
+        back = ht.load_checkpoint({"x": ht.zeros((4, 5), split=0)}, path)
+        self.assert_array_equal(back["x"], x.numpy())
+        self.assertGreaterEqual(
+            self._counters().get("resilience.retry.checkpoint.write", 0), 1
+        )
+
+    def test_torn_write_rejected_on_restore(self):
+        x = ht.array(np.arange(24, dtype=np.float32), split=0)
+        resilience.arm_fault_plan(
+            [{"site": "checkpoint.write", "on_call": 1, "kind": "torn-write",
+              "fraction": 0.25}]
+        )
+        path = os.path.join(self.tmp, "torn")
+        ht.save_checkpoint({"x": x}, path)  # commits a silently truncated leaf
+        with self.assertRaises(ht.CheckpointCorrupt) as ctx:
+            ht.load_checkpoint({"x": ht.zeros((24,), split=0)}, path)
+        self.assertIn("torn write", str(ctx.exception))
+        events = [
+            e for e in self._resilience_events()
+            if e["site"] == "checkpoint.restore" and e["kind"] == "corrupt"
+        ]
+        self.assertTrue(events, self._resilience_events())
+
+
+# ------------------------------------------------------------------ chaos: relay probes
+class TestChaosRelayProbe(_ResilienceCase):
+    def _fake_proc(self, rc):
+        return _pytypes.SimpleNamespace(returncode=rc, stdout=b"", stderr=b"")
+
+    def test_flapping_probes_fold_into_one_outage_window(self):
+        import bench
+
+        bench._PROBES.clear()
+        sleeps = []
+        rcs = iter([1, 1, 0])  # down, down, up
+        with mock.patch("subprocess.run", side_effect=lambda *a, **k: self._fake_proc(next(rcs))):
+            up = bench._backend_reachable(timeout_s=5.0, attempts=3, sleep=sleeps.append)
+        self.assertTrue(up)
+        # every policy attempt landed in the probe history EXACTLY once
+        self.assertEqual([p["up"] for p in bench._PROBES], [False, False, True])
+        self.assertEqual(sleeps, [60.0, 60.0])
+        windows = diagnostics.relay_outage_windows(bench._PROBES)
+        self.assertEqual(len(windows), 1)
+        self.assertIsNotNone(windows[0]["end"])  # the outage closed on the up probe
+
+    def test_all_probes_down_exhausts_and_reports_open_window(self):
+        import bench
+
+        bench._PROBES.clear()
+        with mock.patch("subprocess.run", side_effect=lambda *a, **k: self._fake_proc(1)):
+            up = bench._backend_reachable(timeout_s=5.0, attempts=3, sleep=lambda _s: None)
+        self.assertFalse(up)
+        self.assertEqual([p["up"] for p in bench._PROBES], [False, False, False])
+        windows = diagnostics.relay_outage_windows(bench._PROBES)
+        self.assertEqual(len(windows), 1)
+        self.assertIsNone(windows[0]["end"])  # still open at round end
+
+    def test_injected_probe_fault_skips_the_subprocess(self):
+        import _diag_bootstrap
+        import bench
+
+        res = _diag_bootstrap.load_resilience()
+        self.assertIsNotNone(res)
+        bench._PROBES.clear()
+        res.arm_fault_plan(
+            [{"site": "probe.relay", "on_call": 1, "count": 99, "kind": "backend-down"}]
+        )
+        try:
+            with mock.patch(
+                "subprocess.run",
+                side_effect=AssertionError("probe must not spawn a child"),
+            ):
+                self.assertFalse(bench._probe_backend(timeout_s=5.0))
+        finally:
+            res.disarm_fault_plan()
+            res.reset(clear_breakers=True)
+        self.assertEqual([p["up"] for p in bench._PROBES], [False])
+
+
+# ------------------------------------------------------------------ breaker satellite
+class TestCapsProbeBreaker(_ResilienceCase):
+    def test_open_relay_breaker_short_circuits_caps_probe(self):
+        clock = _FakeClock()
+        br = resilience.breaker(
+            "backend.relay", failure_threshold=2, cooldown_s=300.0, clock=clock
+        )
+        br.record_failure("relay probe 1")
+        br.record_failure("relay probe 2")
+        self.assertEqual(br.state, resilience.OPEN)
+        with mock.patch(
+            "subprocess.run",
+            side_effect=AssertionError("open breaker must not pay the 90 s child"),
+        ):
+            caps, probe_ok = devices._probe_caps_subprocess()
+        self.assertEqual(caps, {"complex": False, "fft": False})
+        self.assertFalse(probe_ok)
+        self.assertGreaterEqual(br.snapshot()["short_circuits"], 1)
+
+        # half-open after the cooldown: the next probe really runs and closes it
+        clock.t += 301.0
+        good = _pytypes.SimpleNamespace(returncode=0, stdout="CAPS 1 1\n", stderr="")
+        with mock.patch("subprocess.run", return_value=good):
+            caps, probe_ok = devices._probe_caps_subprocess()
+        self.assertEqual(caps, {"complex": True, "fft": True})
+        self.assertTrue(probe_ok)
+        self.assertEqual(br.state, resilience.CLOSED)
+
+    def test_injected_caps_fault_counts_as_relay_failure(self):
+        br = resilience.breaker("backend.relay", failure_threshold=2, cooldown_s=300.0)
+        resilience.arm_fault_plan(
+            [{"site": "probe.caps", "on_call": 1, "kind": "backend-down"}]
+        )
+        with mock.patch(
+            "subprocess.run", side_effect=AssertionError("injected fault must short-circuit")
+        ):
+            caps, probe_ok = devices._probe_caps_subprocess()
+        self.assertEqual(caps, {"complex": False, "fft": False})
+        self.assertFalse(probe_ok)
+        self.assertEqual(br.snapshot()["failures"], 1)
+
+
+# ------------------------------------------------------- cross-instance breaker state
+class TestCrossInstanceBreakerSharing(_ResilienceCase):
+    def test_bootstrap_returns_package_instance_once_imported(self):
+        # heat_tpu is imported in this process, so the standalone loader must
+        # hand back the package module — one plan, one breaker registry
+        import _diag_bootstrap
+
+        res = _diag_bootstrap.load_resilience()
+        self.assertIs(res, resilience)
+
+    def test_driver_probe_failures_reach_the_package_breaker(self):
+        """Driver order — standalone resilience loaded BEFORE the package (the
+        bench.py shape): failures its probes record must be visible to
+        devices.relay_breaker() after heat_tpu imports, so caps probes really
+        short-circuit on a relay the driver already measured as down."""
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import _diag_bootstrap\n"
+            "res = _diag_bootstrap.load_resilience()\n"
+            "assert 'heat_tpu' not in sys.modules\n"
+            "res.breaker('backend.relay', failure_threshold=2, cooldown_s=300.0)"
+            ".record_failure('driver probe down')\n"
+            "import heat_tpu  # the package instance adopts the registry\n"
+            "from heat_tpu.core import devices\n"
+            "snap = devices.relay_breaker().snapshot()\n"
+            "assert snap['failures'] == 1, snap\n"
+            "print('SHARED_BREAKER_OK')\n"
+        ) % (here,)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+            timeout=300,
+        )
+        self.assertEqual(proc.returncode, 0, proc.stderr[-1000:])
+        self.assertIn("SHARED_BREAKER_OK", proc.stdout)
+
+
+# ------------------------------------------------------------------ HLO byte-parity
+class TestHLOByteParity(_ResilienceCase):
+    """Armed-but-idle (plan at sites that never fire) and disarmed builds must
+    compile byte-identical HLO: the resilience layer exists strictly OUTSIDE
+    traced program bodies."""
+
+    @staticmethod
+    def _chain_hlos():
+        _executor.clear_executor_cache()
+        np_x = np.arange(8, dtype=np.float32)
+        np_y = np.full(8, 0.5, dtype=np.float32)
+        x = ht.array(np_x, split=0)
+        y = ht.array(np_y, split=0)
+        (x + y).sum().parray
+        with _executor._lock:
+            entries = [
+                e for e in _executor._programs.values()
+                if e is not _executor.UNSUPPORTED and e.arg_specs is not None
+            ]
+        texts = {}
+        for entry in entries:
+            fn = jax.jit(
+                entry._traced(),
+                out_shardings=entry.out_shardings,
+                keep_unused=entry.donate_index is not None,
+            )
+            texts[entry.label] = fn.lower(*entry.arg_specs).compile().as_text()
+        return texts
+
+    def test_hlo_byte_parity_armed_vs_disarmed(self):
+        diagnostics.disable()
+        baseline = self._chain_hlos()
+        self.assertGreaterEqual(len(baseline), 2, list(baseline))
+        resilience.arm_fault_plan(
+            [{"site": "never.fires", "on_call": 10**9, "kind": "raise"}]
+        )
+        armed = self._chain_hlos()
+        self.assertEqual(armed, baseline, "arming a fault plan changed compiled HLO")
+        resilience.disarm_fault_plan()
+        again = self._chain_hlos()
+        self.assertEqual(again, baseline, "disarming did not restore byte-identical HLO")
+
+
+# ------------------------------------------------------------------ canned env plan (CI)
+class TestEnvCannedPlan(_ResilienceCase):
+    def test_env_canned_plan_end_to_end(self):
+        """The CI chaos job's shape: a hermetic child arms a canned
+        HEAT_TPU_FAULT_PLAN from the environment, computes through the faulted
+        sites, and must match numpy bit-for-bit."""
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        plan = [
+            {"site": "comm.shard", "on_call": 1, "kind": "raise"},
+            {"site": "executor.execute", "on_call": 1, "count": 99, "kind": "raise"},
+        ]
+        ndev = os.environ.get("HEAT_TPU_TEST_DEVICES", "8")
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={ndev}",
+            HEAT_TPU_FAULT_PLAN=json.dumps(plan),
+            HEAT_TPU_JIT_THRESHOLD="1",
+        )
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import numpy as np\n"
+            "import heat_tpu as ht\n"
+            "from heat_tpu.core import resilience\n"
+            "assert resilience._armed, 'env plan must arm at import'\n"
+            "np_a = np.arange(10, dtype=np.float32)\n"
+            "x = ht.array(np_a, split=0)\n"
+            "y = (x + 1.0) * 2.0\n"
+            "np.testing.assert_array_equal(y.numpy(), (np_a + 1.0) * 2.0)\n"
+            "stats = ht.executor_stats()\n"
+            "assert stats['eager_fallbacks'] >= 1, stats\n"
+            "print('CANNED_PLAN_OK')\n"
+        ) % (here,)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+            timeout=300,
+        )
+        self.assertEqual(proc.returncode, 0, proc.stderr[-1000:])
+        self.assertIn("CANNED_PLAN_OK", proc.stdout)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
