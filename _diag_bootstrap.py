@@ -5,15 +5,44 @@
 ``heat_tpu`` package initialises the XLA backend (the world mesh is built at
 import), which blocks forever against a dead relay. So the module is loaded BY
 FILE PATH here, once, and the ``HEAT_TPU_DIAG_LOG`` transition log is defaulted
-to ``DIAG_RELAY.jsonl`` next to this file. ``diagnostics.py`` keeps its
-top-level imports stdlib-only precisely so this works.
+to ``benchmarks/out/DIAG_RELAY.jsonl`` (the bench output directory, created on
+demand and gitignored — the old repo-root default left working-tree litter
+next to the sources). :func:`read_relay_log` still reads the legacy root-level
+file, so history recorded before the move stays visible. ``diagnostics.py``
+keeps its top-level imports stdlib-only precisely so this works.
 """
 
 import importlib.util
+import json
 import os
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-DEFAULT_LOG = os.path.join(_HERE, "DIAG_RELAY.jsonl")
+_OUT_DIR = os.path.join(_HERE, "benchmarks", "out")
+DEFAULT_LOG = os.path.join(_OUT_DIR, "DIAG_RELAY.jsonl")
+LEGACY_LOGS = (os.path.join(_HERE, "DIAG_RELAY.jsonl"),)
+
+
+def read_relay_log():
+    """Every recorded backend-health transition, oldest first: the legacy
+    repo-root log (rounds before the path moved) followed by the current one.
+    Unparseable lines are skipped — the log is append-only JSONL written
+    best-effort across process deaths."""
+    records = []
+    for path in (*LEGACY_LOGS, os.environ.get("HEAT_TPU_DIAG_LOG") or DEFAULT_LOG):
+        if not path or not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and "backend" in rec:
+                        records.append(rec["backend"])
+        except OSError:
+            continue
+    return records
 
 _DIAG = None
 _RESILIENCE = None
@@ -65,6 +94,11 @@ def load_diagnostics():
     recording as best-effort."""
     global _DIAG
     os.environ.setdefault("HEAT_TPU_DIAG_LOG", DEFAULT_LOG)
+    if os.environ["HEAT_TPU_DIAG_LOG"] == DEFAULT_LOG:
+        try:
+            os.makedirs(_OUT_DIR, exist_ok=True)
+        except OSError:
+            pass  # diagnostics' log append already degrades gracefully
     if _DIAG is not None:
         return _DIAG
     path = os.path.join(_HERE, "heat_tpu", "core", "diagnostics.py")

@@ -56,8 +56,9 @@ class _RelayDown(RuntimeError):
 
 # Every relay probe this round, in order: {"t", "up", "latency_s", "detail"}.
 # Transitions additionally land in the diagnostics log (HEAT_TPU_DIAG_LOG,
-# defaulted to DIAG_RELAY.jsonl next to this file) and the outage-window
-# summary is attached to the emitted JSON line as `relay_outage_windows`.
+# defaulted to benchmarks/out/DIAG_RELAY.jsonl by _diag_bootstrap) and the
+# outage-window summary is attached to the emitted JSON line as
+# `relay_outage_windows`.
 _PROBES = []
 
 
@@ -326,6 +327,43 @@ def _bench_dispatch(devices: int = 8, timeout_s: float = 900.0) -> list:
     return records
 
 
+def _bench_serving(devices: int = 8, timeout_s: float = 900.0) -> list:
+    """Host-side serving latency smoke (``benchmarks/serving/harness.py``) in a
+    hermetic virtual CPU mesh subprocess: closed+open-loop throughput with
+    p50/p99 and the profiler's mergeable latency-histogram snapshots
+    (``profiler_schema`` rides in every record). Pure host-side like the
+    dispatch microbenchmark, so null-marker rounds (relay down) still carry
+    request-level latency evidence."""
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "serving",
+        "harness.py",
+    )
+    proc = subprocess.run(
+        [sys.executable, script, "--devices", str(devices), "--smoke"],
+        capture_output=True,
+        text=True,
+        timeout=timeout_s,
+    )
+    records = []
+    for line in proc.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            records.append(rec)
+    if not records:
+        raise RuntimeError(
+            f"serving harness produced no records (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}"
+        )
+    return records
+
+
 def _probe_backend(timeout_s: float = 150.0, detail: str = "") -> bool:
     """One killable-subprocess backend-initialisation probe (an in-process
     ``jax.devices()`` against a dead relay blocks in C and ignores signals),
@@ -488,12 +526,17 @@ def main():
     # matches the success-path name for the TPU shape so null datapoints join the series
     _FAIL_METRIC = "matmul_32768x32768_bfloat16_split0x1_tflops_per_chip"
 
-    # Host-side dispatch throughput first: it needs no accelerator (hermetic
-    # virtual-CPU-mesh subprocess), so the trajectory captures it every round,
-    # relay up or down.
+    # Host-side metrics first: neither needs the accelerator (hermetic
+    # virtual-CPU-mesh subprocesses), so the trajectory captures dispatch
+    # ops/s AND serving p50/p99 + histogram snapshots every round, relay up
+    # or down.
     dispatch_extras = []
     try:
         dispatch_extras = _bench_dispatch()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    try:
+        dispatch_extras += _bench_serving()
     except Exception:
         traceback.print_exc(file=sys.stderr)
 

@@ -1,0 +1,8 @@
+"""End-to-end serving benchmark suite (ROADMAP "scenario diversity" item).
+
+``harness.py`` drives mixed realistic workloads (``workloads.py``) under
+concurrency — closed-loop and open-loop Poisson arrivals — and reports
+throughput plus p50/p99 latency via ``ht.profiler``, gated in CI against the
+committed lower-envelope ``serving_baseline.json`` at 3 and 8 virtual devices
+(the ``benchmarks/cb/dispatch_baseline.json`` pattern, one level up the stack).
+"""

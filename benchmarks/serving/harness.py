@@ -1,0 +1,318 @@
+"""End-to-end serving load harness: throughput and latency percentiles under
+concurrency, gated against a committed lower envelope.
+
+Drives the four workloads in ``workloads.py`` through two load shapes:
+
+- **closed loop** — ``--concurrency`` worker threads issue requests
+  back-to-back; measures the system's sustainable throughput and the service
+  latency at full utilisation. This is the gated mode.
+- **open loop** — requests arrive on a Poisson schedule at an offered rate of
+  ``--open-fraction`` × the measured closed-loop throughput, served by the
+  same worker pool; latency is measured from the *scheduled arrival*, so
+  queueing delay counts — the number a user behind a load balancer would see.
+
+Every request runs inside ``ht.profiler.request(tag)``, so the emitted records
+carry the profiler's log-bucketed latency-histogram snapshots (mergeable
+offline across rounds/shards) next to the exact percentiles, and
+``--trace-out`` dumps the whole run as a Chrome/Perfetto trace with one track
+per request.
+
+Output is one BENCH-style JSON line per (workload, mode)::
+
+    {"metric": "serving_kmeans_assign_closed_rps", "value": 41.2,
+     "unit": "req/s", "p50_ms": ..., "p99_ms": ..., "latency_hist": {...},
+     "profiler_schema": "heat-tpu-profiler/1", "devices": 8, ...}
+
+``--check --baseline benchmarks/serving/serving_baseline.json`` gates the
+closed-loop records: throughput must stay above ``min_rps`` and p50/p99 below
+``max_p50_ms``/``max_p99_ms`` for the device count — a lower envelope recorded
+well below the observed numbers (CI boxes are noisy; the gate catches
+collapses, not jitter), the ``dispatch_baseline.json`` pattern one level up
+the stack. A device count or workload with no baseline entry emits a VISIBLE
+warning instead of silently not gating.
+
+Standalone (bootstraps a virtual CPU mesh, the conftest pattern)::
+
+    python benchmarks/serving/harness.py --devices 8 --smoke --check \\
+        --baseline benchmarks/serving/serving_baseline.json \\
+        --trace-out serving-trace.json --diag-out serving-diag.json
+"""
+
+import itertools
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+WARMUP_REQUESTS = 3
+
+
+def _bootstrap(devices: int) -> None:
+    """Re-exec into a hermetic virtual CPU mesh of ``devices`` devices (the
+    test conftest pattern; see benchmarks/cb/dispatch.py)."""
+    if os.environ.get("_HEAT_TPU_SERVING_BENCH_REEXEC") == "1":
+        return
+    env = dict(os.environ)
+    env["_HEAT_TPU_SERVING_BENCH_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # sitecustomize: skip TPU plugin registration
+    # the harness measures the metrics-off framework with only the profiler on;
+    # scrub ambient knobs a debugging session may have exported
+    for knob in (
+        "HEAT_TPU_METRICS",
+        "HEAT_TPU_TRACE",
+        "HEAT_TPU_DIAG_DUMP",
+        "HEAT_TPU_EAGER_DISPATCH",
+        "HEAT_TPU_JIT_THRESHOLD",
+        "HEAT_TPU_PROFILE",
+        "HEAT_TPU_PROFILE_TRACE",
+    ):
+        env.pop(knob, None)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _percentile_ms(latencies, q: float) -> float:
+    """Exact nearest-rank percentile of a latency list, in milliseconds."""
+    ordered = sorted(latencies)
+    idx = min(len(ordered) - 1, max(0, round(q * (len(ordered) - 1))))
+    return ordered[idx] * 1e3
+
+
+def _load_loop(profiler, wl, tag: str, n_requests: int, concurrency: int,
+               arrivals=None):
+    """``concurrency`` worker threads drain ``n_requests``. With ``arrivals``
+    None this is the closed loop: requests issue back-to-back and latency is
+    bare service time. With ``arrivals`` (a list of start offsets in seconds)
+    it is the open loop: each request waits for its scheduled arrival and
+    latency counts FROM that arrival, so queueing delay when all workers are
+    busy is part of the number (an M/?/c queue's response time, not its bare
+    service time). Returns (per-request latencies [s], wall seconds)."""
+    counter = itertools.count()
+    lat_lists = [[] for _ in range(concurrency)]
+    errors = []
+    start = time.perf_counter()
+
+    def worker(slot: int) -> None:
+        while True:
+            i = next(counter)
+            if i >= n_requests:
+                return
+            if arrivals is None:
+                t0 = time.perf_counter()
+            else:
+                t0 = start + arrivals[i]
+                now = time.perf_counter()
+                if now < t0:
+                    time.sleep(t0 - now)
+            try:
+                with profiler.request(tag):
+                    wl.fn(i)
+            except Exception as exc:  # a failed request fails the whole case
+                errors.append(exc)
+                return
+            lat_lists[slot].append(time.perf_counter() - t0)
+
+    threads = [
+        threading.Thread(target=worker, args=(s,), daemon=True)
+        for s in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - start
+    if errors:
+        raise errors[0]
+    return [lat for lats in lat_lists for lat in lats], wall
+
+
+def _poisson_arrivals(n_requests: int, rate_rps: float, seed: int = 0):
+    rng = random.Random(seed)
+    arrivals, t = [], 0.0
+    for _ in range(n_requests):
+        t += rng.expovariate(rate_rps)
+        arrivals.append(t)
+    return arrivals
+
+
+def _record(name: str, mode: str, latencies, wall: float, ndev: int,
+            concurrency: int, hist_snapshot, offered_rps=None) -> dict:
+    from heat_tpu.core import profiler
+
+    rec = {
+        "metric": f"serving_{name}_{mode}_rps",
+        "value": round(len(latencies) / wall, 2),
+        "unit": "req/s",
+        "workload": name,
+        "mode": mode,
+        "devices": ndev,
+        "concurrency": concurrency,
+        "requests": len(latencies),
+        "p50_ms": round(_percentile_ms(latencies, 0.50), 3),
+        "p95_ms": round(_percentile_ms(latencies, 0.95), 3),
+        "p99_ms": round(_percentile_ms(latencies, 0.99), 3),
+        "max_ms": round(max(latencies) * 1e3, 3),
+        "latency_hist": hist_snapshot,
+        "profiler_schema": profiler.SCHEMA,
+    }
+    if offered_rps is not None:
+        rec["offered_rps"] = round(offered_rps, 2)
+    return rec
+
+
+def _gate_closed(rec: dict, envelope, emit) -> bool:
+    """Apply the lower-envelope gate to one closed-loop record. Returns True
+    on failure. ``envelope`` None → visible warning, not a silent pass."""
+    name = rec["workload"]
+    if envelope is None:
+        emit(json.dumps({
+            "warning": f"baseline has no '{name}' entry at {rec['devices']} "
+            "devices; serving SLO not gated for this case"
+        }))
+        return False
+    failed = False
+    min_rps = envelope.get("min_rps")
+    if min_rps is not None and rec["value"] < min_rps:
+        failed = True
+        emit(json.dumps({
+            "error": f"{name}: {rec['value']} req/s below the baseline "
+            f"lower envelope {min_rps} req/s"
+        }))
+    for pkey, ekey in (("p50_ms", "max_p50_ms"), ("p99_ms", "max_p99_ms")):
+        bound = envelope.get(ekey)
+        if bound is not None and rec[pkey] > bound:
+            failed = True
+            emit(json.dumps({
+                "error": f"{name}: {pkey} {rec[pkey]} ms above the baseline "
+                f"envelope {bound} ms"
+            }))
+    return failed
+
+
+def run(
+    smoke: bool = True,
+    requests: int = 32,
+    concurrency: int = 4,
+    open_fraction: float = 0.6,
+    which=None,
+    check: bool = False,
+    baseline: dict = None,
+    trace_out: str = None,
+    diag_out: str = None,
+    emit=print,
+):
+    """Run the suite; returns ``(records, failed)`` — one record per
+    (workload, mode), and whether any closed-loop record broke its envelope
+    under ``check``/``baseline`` (``{str(devices): {workload: envelope}}``).
+    The CLI turns ``failed`` into a non-zero exit; in-process callers get the
+    gate verdict as a value instead of a ``SystemExit``."""
+    import jax
+
+    from heat_tpu.core import diagnostics, profiler
+    from benchmarks.serving.workloads import build_workloads
+
+    ndev = len(jax.devices())
+    base_cases = (baseline or {}).get(str(ndev), {})
+    if baseline is not None and not base_cases:
+        emit(json.dumps({
+            "warning": f"baseline has no entry for {ndev} devices; "
+            "the serving SLO gate is not being enforced on this run"
+        }))
+
+    was_active = profiler.active()
+    profiler.enable()
+    records, failed = [], False
+    try:
+        for wl in build_workloads(smoke=smoke, which=which):
+            for i in range(WARMUP_REQUESTS):  # compile paths, uncounted
+                wl.fn(i)
+            tag_closed = f"{wl.name}.closed"
+            lats, wall = _load_loop(
+                profiler, wl, tag_closed, requests, concurrency
+            )
+            hist = profiler.histogram_snapshots().get(f"request.{tag_closed}")
+            rec = _record(wl.name, "closed", lats, wall, ndev, concurrency, hist)
+            records.append(rec)
+            emit(json.dumps(rec))
+            if check or baseline:
+                failed |= _gate_closed(rec, base_cases.get(wl.name), emit)
+
+            closed_rps = rec["value"]
+            offered = max(0.5, open_fraction * closed_rps)
+            n_open = max(8, (2 * requests) // 3)
+            tag_open = f"{wl.name}.open"
+            lats, wall = _load_loop(
+                profiler, wl, tag_open, n_open, concurrency,
+                arrivals=_poisson_arrivals(n_open, offered),
+            )
+            hist = profiler.histogram_snapshots().get(f"request.{tag_open}")
+            rec = _record(wl.name, "open", lats, wall, ndev, concurrency, hist,
+                          offered_rps=offered)
+            records.append(rec)
+            emit(json.dumps(rec))
+        if trace_out:
+            profiler.dump_trace(trace_out)
+            emit(json.dumps({"artifact": "perfetto_trace", "path": trace_out}))
+        if diag_out:
+            diagnostics.dump(diag_out)
+            emit(json.dumps({"artifact": "diagnostics_json", "path": diag_out}))
+    finally:
+        if not was_active:
+            profiler.disable()
+    return records, failed
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI shapes: tiny corpora, sub-minute suite")
+    parser.add_argument("--requests", type=int, default=None,
+                        help="closed-loop requests per workload "
+                        "(default 32 smoke, 128 full)")
+    parser.add_argument("--concurrency", type=int, default=4)
+    parser.add_argument("--open-fraction", type=float, default=0.6,
+                        help="open-loop offered rate as a fraction of the "
+                        "measured closed-loop throughput")
+    parser.add_argument("--workloads", nargs="*", default=None,
+                        help="subset of workload names (default: all four)")
+    parser.add_argument("--check", action="store_true",
+                        help="exit non-zero when a closed-loop record breaks "
+                        "its baseline envelope")
+    parser.add_argument("--baseline",
+                        help="JSON lower-envelope file "
+                        "({devices: {workload: {min_rps, max_p50_ms, max_p99_ms}}})")
+    parser.add_argument("--trace-out", help="dump the run's Perfetto trace here")
+    parser.add_argument("--diag-out", help="dump the ht.diagnostics report here")
+    args = parser.parse_args()
+    _bootstrap(args.devices)
+    baseline = None
+    if args.baseline:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    _, failed = run(
+        smoke=args.smoke,
+        requests=args.requests or (32 if args.smoke else 128),
+        concurrency=args.concurrency,
+        open_fraction=args.open_fraction,
+        which=args.workloads,
+        check=args.check,
+        baseline=baseline,
+        trace_out=args.trace_out,
+        diag_out=args.diag_out,
+    )
+    if args.check and failed:
+        sys.exit(1)

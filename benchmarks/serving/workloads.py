@@ -1,0 +1,148 @@
+"""The serving workload zoo: four realistic request shapes over the framework.
+
+Each builder returns a :class:`Workload` whose ``fn(i)`` executes ONE request
+end-to-end — framework dispatch, any collectives, and a synchronous result
+readback (``block_until_ready``) so the measured latency is what a caller
+would wait. State (corpora, fitted models, query batches) is built once in
+the builder and treated as read-only afterwards, so requests are safe to
+issue from many threads at once; each request rotates through a small pool of
+pre-staged input batches so the signature cache is exercised as replay (the
+serving steady state), not as compile.
+
+The four shapes cover the domain modules the ROADMAP names:
+
+- ``kmeans_assign``  — streaming KMeans assignment: nearest-centroid labels
+  for a row-split batch against a fitted model (``KMeans.predict``).
+- ``cdist_knn``      — batched spatial nearest-neighbour: ``ht.spatial.cdist``
+  of a query batch against a row-split corpus, then ``ht.argmin`` over the
+  corpus axis.
+- ``mlp_infer``      — DP-MLP inference: a Linear→ReLU→Linear forward over a
+  row-split batch.
+- ``sparse_matvec``  — sparse DCSR matvec: a BCOO ``dot_general`` against a
+  dense vector, the DCSR matrix built once via ``ht.sparse.sparse_csr_matrix``.
+
+``smoke=True`` (the CI shape) keeps every corpus small enough that the whole
+suite runs in well under a minute on a virtual CPU mesh; ``smoke=False`` is
+the on-chip shape.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple
+
+N_BATCH_POOL = 8  # pre-staged input batches each request rotates through
+
+
+class Workload(NamedTuple):
+    name: str
+    fn: Callable[[int], None]  # run request i, synchronously
+
+
+def _batch_pool(ht, jax, jnp, key, shape, split):
+    return [
+        ht.array(
+            jax.random.normal(jax.random.key(key + i), shape, jnp.float32),
+            split=split,
+        )
+        for i in range(N_BATCH_POOL)
+    ]
+
+
+def build_kmeans_assign(ht, jax, jnp, smoke: bool) -> Workload:
+    n, d, k, batch = (8192, 16, 8, 512) if smoke else (10_000_000, 64, 8, 65_536)
+    x = ht.array(jax.random.normal(jax.random.key(10), (n, d), jnp.float32), split=0)
+    km = ht.cluster.KMeans(n_clusters=k, init="random", max_iter=5, tol=-1.0,
+                           random_state=0)
+    km.fit(x)
+    batches = _batch_pool(ht, jax, jnp, 20, (batch, d), 0)
+
+    def fn(i: int) -> None:
+        labels = km.predict(batches[i % N_BATCH_POOL])
+        jax.block_until_ready(labels.parray)
+
+    return Workload("kmeans_assign", fn)
+
+
+def build_cdist_knn(ht, jax, jnp, smoke: bool) -> Workload:
+    n, d, batch = (2048, 16, 64) if smoke else (262_144, 64, 1024)
+    corpus = ht.array(
+        jax.random.normal(jax.random.key(30), (n, d), jnp.float32), split=0
+    )
+    # queries replicated, corpus row-split: the serving layout (a small batch
+    # against a large sharded corpus; the result arrives split along the
+    # corpus axis and argmin reduces over it)
+    batches = _batch_pool(ht, jax, jnp, 40, (batch, d), None)
+
+    def fn(i: int) -> None:
+        dist = ht.spatial.cdist(batches[i % N_BATCH_POOL], corpus)
+        nearest = ht.argmin(dist, axis=1)
+        jax.block_until_ready(nearest.parray)
+
+    return Workload("cdist_knn", fn)
+
+
+def build_mlp_infer(ht, jax, jnp, smoke: bool) -> Workload:
+    d, h, classes, batch = (64, 128, 10, 256) if smoke else (784, 1024, 10, 8192)
+    model = ht.nn.Sequential(
+        ht.nn.Linear(d, h), ht.nn.ReLU(), ht.nn.Linear(h, classes)
+    )
+    model.params  # materialise once: concurrent requests then only read
+    batches = _batch_pool(ht, jax, jnp, 50, (batch, d), 0)
+
+    def fn(i: int) -> None:
+        logits = model(batches[i % N_BATCH_POOL])
+        jax.block_until_ready(logits.parray)
+
+    return Workload("mlp_infer", fn)
+
+
+def build_sparse_matvec(ht, jax, jnp, smoke: bool) -> Workload:
+    from jax.experimental import sparse as jsparse
+
+    n, density = (2048, 0.005) if smoke else (262_144, 0.0005)
+    key = jax.random.key(60)
+    mask = jax.random.uniform(key, (n, n)) < density
+    dense = jax.random.normal(jax.random.key(61), (n, n), jnp.float32) * mask
+    mat = ht.sparse.sparse_csr_matrix(dense, split=0)
+
+    matvec = jax.jit(
+        lambda a, v: jsparse.bcoo_dot_general(
+            a, v, dimension_numbers=(((1,), (0,)), ((), ()))
+        )
+    )
+    vecs = [
+        jax.random.normal(jax.random.key(70 + i), (n,), jnp.float32)
+        for i in range(N_BATCH_POOL)
+    ]
+    bcoo = mat.larray
+
+    def fn(i: int) -> None:
+        jax.block_until_ready(matvec(bcoo, vecs[i % N_BATCH_POOL]))
+
+    return Workload("sparse_matvec", fn)
+
+
+BUILDERS = {
+    "kmeans_assign": build_kmeans_assign,
+    "cdist_knn": build_cdist_knn,
+    "mlp_infer": build_mlp_infer,
+    "sparse_matvec": build_sparse_matvec,
+}
+
+
+def build_workloads(smoke: bool = True, which=None) -> List[Workload]:
+    """Build the requested workloads (all four by default). Imports the
+    framework here — callers bootstrap the device mesh first."""
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+
+    names = list(BUILDERS) if not which else list(which)
+    out = []
+    for name in names:
+        builder = BUILDERS.get(name)
+        if builder is None:
+            raise ValueError(f"unknown workload {name!r}; known: {sorted(BUILDERS)}")
+        out.append(builder(ht, jax, jnp, smoke))
+    return out

@@ -1,6 +1,7 @@
 """heat_tpu core: distributed n-D arrays over JAX/XLA (reference heat/core/__init__.py)."""
 
 from . import diagnostics
+from . import profiler
 from . import resilience
 from .communication import *
 from ._executor import executor_stats, reset_executor_stats, clear_executor_cache

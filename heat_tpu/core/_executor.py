@@ -85,7 +85,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import diagnostics, resilience
+from . import diagnostics, profiler, resilience
 
 __all__ = [
     "executor_stats",
@@ -109,6 +109,12 @@ executor cannot stage; the wrapper takes the eager path."""
 
 
 class _Stats:
+    # Concurrency note (serving-harness audit): most tallies are incremented
+    # under the executor lock (lookup, the whole fused force); the exceptions
+    # — `retraces` inside a traced body, the memoised-read fast path of
+    # `Deferred.force` — are RELAXED by design: a racing += may undercount,
+    # never corrupt, and locking them would put an acquire on paths that are
+    # documented as costing one attribute read / nothing.
     __slots__ = (
         "hits", "misses", "retraces",
         # multi-output fused-graph telemetry (see _force_graph)
@@ -539,7 +545,20 @@ class _Program:
                         for a in args
                     )
             t0 = time.perf_counter()
-        if diagnostics._tracing:
+        if profiler._active:
+            # host-side timing only (never inside the traced body — the HLO
+            # parity contract): the first call spans trace + XLA compile +
+            # first execution, replays span C++ dispatch
+            with profiler.scope("compile" if first else "execute",
+                                self.label or "program"):
+                if diagnostics._tracing:
+                    with jax.profiler.TraceAnnotation(
+                        f"ht.dispatch:{self.label or 'program'}"
+                    ):
+                        out = fn(*args)
+                else:
+                    out = fn(*args)
+        elif diagnostics._tracing:
             with jax.profiler.TraceAnnotation(f"ht.dispatch:{self.label or 'program'}"):
                 out = fn(*args)
         else:
@@ -727,7 +746,8 @@ class Deferred:
     ``executor_stats()["reexecuted"]``)."""
 
     __slots__ = ("operation", "fn_kwargs", "operands", "shape", "dtype",
-                 "gshape", "split", "comm", "size", "value", "wref", "executed")
+                 "gshape", "split", "comm", "size", "value", "wref", "executed",
+                 "req")
 
     def __init__(self, operation, fn_kwargs, operands, shape, dtype, gshape, split, comm, size):
         self.operation = operation
@@ -742,6 +762,11 @@ class Deferred:
         self.value = None
         self.wref = None
         self.executed = False
+        # profiler attribution captured at defer time: a chain built inside a
+        # request scope but forced later (another thread, scope closed) still
+        # attributes its force to the request that built it. None when the
+        # profiler is off — defer_node never pays for it idle.
+        self.req = None
 
     @property
     def ndim(self) -> int:
@@ -883,10 +908,13 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
             for kind, v in operands
         )
         size = 1
-    return Deferred(
+    node = Deferred(
         operation, fn_kwargs, tuple(operands), shape, dtype,
         tuple(gshape), split, comm, size,
     )
+    if profiler._active:
+        node.req = profiler.current_request()
+    return node
 
 
 def _pending_count(operands, cap: int) -> int:
@@ -938,6 +966,17 @@ def _force_graph(roots: Tuple[Deferred, ...]) -> None:
     # must be atomic against other threads' forces — a concurrently donated
     # leaf must never reach a program call. RLock: re-entrant from
     # Deferred.force and _Program.__call__'s first-call build.
+    if profiler._active:
+        # attribute the force to the ambient request, falling back to the id a
+        # root captured at defer time (the chain may be forced from another
+        # thread, after the request scope that built it closed)
+        req = next((r.req for r in roots if r.req is not None), None)
+        with profiler.scope(
+            "force", f"force:{_op_label(roots[0].operation)}", req=req
+        ):
+            with _lock:
+                _force_graph_locked(roots)
+        return
     with _lock:
         _force_graph_locked(roots)
 
@@ -1043,6 +1082,13 @@ def _force_graph_locked(roots: Tuple[Deferred, ...]) -> None:
     padded = tuple(root.shape) != gshape
     if padded and diagnostics._enabled:
         diagnostics.record_pad_waste(gshape, split, root.shape[split])
+    if padded and profiler._active:
+        # counter track: pad fraction of the forced family (timeline view of
+        # the aggregate diagnostics pad_waste gauge)
+        profiler.record_counter(
+            "pad_waste_fraction",
+            (root.shape[split] - gshape[split]) / root.shape[split],
+        )
 
     # ---- which entries leave the program as outputs (and get memoised)
     emit = set(root_idxs)
@@ -1179,12 +1225,22 @@ def _force_graph_locked(roots: Tuple[Deferred, ...]) -> None:
                 _stats.donated_bytes += donated
                 if diagnostics._enabled:
                     diagnostics.counter("executor.donated_leaf_bytes", donated)
+                if profiler._active:
+                    # counter track: cumulative donated bytes over the run
+                    profiler.record_counter("donated_bytes", _stats.donated_bytes)
         except Exception as exc:
             if not fallback_after_failure(
                 key, prog, exc, donated=[leaves[i] for i in donate_idx]
             ):
                 raise
             outs = replay_eager()
+    if profiler._active:
+        # force-boundary memory gauge: logical bytes this force touched (leaf
+        # inputs + emitted outputs) — the framework's live working set at the
+        # boundary, not an XLA allocator readout
+        live = sum(v.nbytes for v in leaves if isinstance(v, jax.Array))
+        live += sum(getattr(o, "nbytes", 0) for o in outs)
+        profiler.record_force_memory(live)
     _stats.interior_outputs += n_interior
     _stats.reexec_avoided += memo_hits
     _stats.cse_hits += cse_hits

@@ -35,18 +35,27 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from . import diagnostics, resilience
+from . import diagnostics, profiler, resilience
 
 
 def _guarded(site, fn, *args, **kwargs):
-    """Run one collective (or layout) invocation under ht.resilience.
+    """Run one collective (or layout) invocation under ht.resilience and
+    ht.profiler.
 
-    Idle fast path: one module-attribute read. When a fault plan is armed or a
-    site policy is registered, the call goes through ``resilience.guard`` —
-    injected faults fire per attempt and the site policy retries. Collectives
-    execute at trace time (pure functions of tracers), so a retried call
-    re-traces identically and the compiled HLO never changes (the
-    byte-parity contract in ``tests/test_resilience.py``)."""
+    Idle fast path: one module-attribute read per subsystem. When a fault plan
+    is armed or a site policy is registered, the call goes through
+    ``resilience.guard`` — injected faults fire per attempt and the site
+    policy retries. When the profiler is active the invocation is additionally
+    recorded as a ``collective`` slice attributed to the ambient request scope
+    — collectives run at trace time, so the slice nests inside the program's
+    ``compile`` slice (host-side timing only; nothing enters the traced body,
+    so the compiled HLO never changes — the byte-parity contracts in
+    ``tests/test_resilience.py`` and ``tests/test_profiler.py``)."""
+    if profiler._active:
+        with profiler.scope("collective", site):
+            if resilience._active:
+                return resilience.guard(site, fn, *args, **kwargs)
+            return fn(*args, **kwargs)
     if resilience._active:
         return resilience.guard(site, fn, *args, **kwargs)
     return fn(*args, **kwargs)

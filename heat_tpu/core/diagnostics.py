@@ -55,6 +55,30 @@ Env knobs (read once at import)
 This module deliberately imports only the stdlib at top level so the driver
 entry points (``bench.py``, ``__graft_entry__.py``) can load it by file path
 *before* deciding whether touching the JAX backend is safe.
+
+Thread-safety (audited for the multi-threaded serving harness)
+--------------------------------------------------------------
+Every mutation of the shared registries — counters, spans, collective and
+pad-waste aggregates, the bounded event deques, the backend-state transition
+check, provider registration — runs under the one module ``_lock``, and
+:func:`report`/:func:`reset` snapshot/clear under the same lock, so counts
+are EXACT under concurrent requests (``tests/test_diagnostics.py::
+TestThreadSafety`` hammers this). The deliberate exceptions, relaxed rather
+than locked:
+
+- the ``_enabled`` / ``_tracing`` switches are bare module attributes: hot
+  paths read them un-locked (the zero-cost contract), so a concurrent
+  ``enable()``/``disable()`` takes effect on other threads at their next
+  hook — no torn state is possible (bool writes are atomic), only a few
+  events either side of the flip may or may not be collected;
+- the ``HEAT_TPU_DIAG_LOG`` file append in :func:`record_backend_event` runs
+  OUTSIDE the lock (a slow disk must not stall telemetry); interleaved lines
+  from two processes are whole-line atomic on POSIX appends of this size;
+- the executor's ``_stats`` tallies (in :mod:`_executor`) are incremented
+  un-locked on a few hot paths (``retraces`` inside a traced body, the
+  memo-hit ``reexec_avoided`` fast path) — they may UNDERCOUNT under racing
+  threads, never corrupt; the signature table itself and every decision made
+  from it are fully lock-protected.
 """
 
 from __future__ import annotations
@@ -185,7 +209,8 @@ def register_provider(name: str, fn: Callable[[], Any]) -> None:
     """Attach a named report section computed at :func:`report` time (the
     executor registers its stats here; avoids an import cycle and keeps this
     module standalone-loadable)."""
-    _providers[name] = fn
+    with _lock:
+        _providers[name] = fn
 
 
 # ------------------------------------------------------------------ primitives
@@ -390,7 +415,9 @@ def report() -> dict:
             "backend_events": list(_backend_events),
         }
     rep["relay_outage_windows"] = relay_outage_windows(rep["backend_events"])
-    for name, provider in list(_providers.items()):
+    with _lock:
+        providers = list(_providers.items())
+    for name, provider in providers:
         try:
             rep[name] = provider()
         except Exception as exc:  # a broken provider must not kill the report

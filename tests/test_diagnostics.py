@@ -437,3 +437,149 @@ class TestZeroOverheadContract(_DiagTestCase):
         diagnostics.reset()
         (a * 2.0).parray
         self.assertEqual(diagnostics.report()["pad_waste"], [])
+
+
+class TestThreadSafety(_DiagTestCase):
+    """ISSUE 7 satellite: the serving harness hammers the registries from many
+    threads at once — every lock-protected mutation site must stay EXACT
+    (counters, spans, collective aggregates, bounded deques), and concurrent
+    framework dispatch with metrics on must neither crash nor let an event
+    stream outgrow its bound. The deliberately relaxed sites (hot-path
+    executor tallies, the enable/disable switches) are documented in the
+    diagnostics module docstring, not asserted exact here."""
+
+    def test_hammer_exact_counts(self):
+        import threading
+
+        diagnostics.reset()
+        n_threads, n_iters = 8, 500
+        errors = []
+
+        def hammer(slot):
+            try:
+                for i in range(n_iters):
+                    diagnostics.counter("hammer.counter", 1)
+                    with diagnostics.span("hammer.span"):
+                        pass
+                    diagnostics.record_collective("hammer", "d", 8, 64)
+                    diagnostics.record_dispatch_event("miss", "hammer", f"{slot}:{i}")
+            except Exception as exc:  # pragma: no cover - failure path
+                errors.append(exc)
+
+        with metrics():
+            threads = [
+                __import__("threading").Thread(target=hammer, args=(s,))
+                for s in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            self.assertEqual(errors, [])
+            rep = diagnostics.report()
+        total = n_threads * n_iters
+        self.assertEqual(rep["counters"]["hammer.counter"], total)
+        self.assertEqual(rep["spans"]["hammer.span"]["count"], total)
+        coll = [c for c in rep["collectives"] if c["op"] == "hammer"]
+        self.assertEqual(len(coll), 1)
+        self.assertEqual(coll[0]["count"], total)
+        self.assertEqual(coll[0]["bytes"], total * 64)
+        # the bounded deque holds the most recent tail, never more
+        self.assertLessEqual(len(rep["dispatch_events"]), diagnostics._MAX_EVENTS)
+
+    def test_concurrent_framework_dispatch(self):
+        import threading
+
+        errors = []
+
+        def serve(seed):
+            try:
+                a = ht.array(np.full(32, float(seed), dtype=np.float32), split=0)
+                for _ in range(5):
+                    ((a + 1.0) * 0.5).sum().parray
+            except Exception as exc:  # pragma: no cover - failure path
+                errors.append(exc)
+
+        with metrics():
+            threads = [threading.Thread(target=serve, args=(s,)) for s in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            rep = diagnostics.report()
+        self.assertEqual(errors, [])
+        # shard is recorded per layout request — at least one per thread's array
+        shards = [c for c in rep["collectives"] if c["op"] == "shard"]
+        self.assertTrue(shards)
+
+    def test_provider_registration_during_report(self):
+        # register_provider now takes the registry lock; racing registration
+        # against report() must neither drop sections nor raise
+        import threading
+
+        stop = threading.Event()
+
+        def spin_register():
+            i = 0
+            while not stop.is_set():
+                diagnostics.register_provider(f"_hammer_{i % 4}", lambda: {"ok": 1})
+                i += 1
+
+        t = threading.Thread(target=spin_register)
+        t.start()
+        try:
+            for _ in range(20):
+                rep = diagnostics.report()
+                self.assertIn("schema", rep)
+        finally:
+            stop.set()
+            t.join()
+        for i in range(4):
+            diagnostics._providers.pop(f"_hammer_{i}", None)
+
+
+class TestDiagLogPaths(_DiagTestCase):
+    """ISSUE 7 satellite: the default relay log moved out of the repo root
+    (working-tree litter) into benchmarks/out/, with legacy paths readable."""
+
+    def test_default_under_bench_out(self):
+        import _diag_bootstrap
+
+        self.assertEqual(
+            os.path.relpath(
+                _diag_bootstrap.DEFAULT_LOG,
+                os.path.dirname(os.path.abspath(_diag_bootstrap.__file__)),
+            ),
+            os.path.join("benchmarks", "out", "DIAG_RELAY.jsonl"),
+        )
+        root = os.path.dirname(os.path.abspath(_diag_bootstrap.__file__))
+        with open(os.path.join(root, ".gitignore")) as f:
+            ignored = f.read()
+        self.assertIn("benchmarks/out/", ignored)
+        self.assertIn("DIAG_RELAY.jsonl", ignored)  # the legacy root name
+
+    def test_read_relay_log_merges_legacy(self):
+        import _diag_bootstrap
+
+        with tempfile.TemporaryDirectory() as d:
+            legacy = os.path.join(d, "legacy.jsonl")
+            current = os.path.join(d, "current.jsonl")
+            with open(legacy, "w") as f:
+                f.write(json.dumps({"backend": {"t": "a", "up": True}}) + "\n")
+                f.write("not json\n")  # torn line: skipped, not fatal
+                f.write(json.dumps({"backend": {"t": "b", "up": False}}) + "\n")
+            with open(current, "w") as f:
+                f.write(json.dumps({"backend": {"t": "c", "up": True}}) + "\n")
+            old_legacy = _diag_bootstrap.LEGACY_LOGS
+            old_env = os.environ.get("HEAT_TPU_DIAG_LOG")
+            _diag_bootstrap.LEGACY_LOGS = (legacy,)
+            os.environ["HEAT_TPU_DIAG_LOG"] = current
+            try:
+                records = _diag_bootstrap.read_relay_log()
+            finally:
+                _diag_bootstrap.LEGACY_LOGS = old_legacy
+                if old_env is None:
+                    del os.environ["HEAT_TPU_DIAG_LOG"]
+                else:
+                    os.environ["HEAT_TPU_DIAG_LOG"] = old_env
+        self.assertEqual([r["t"] for r in records], ["a", "b", "c"])

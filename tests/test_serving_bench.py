@@ -1,0 +1,148 @@
+"""Serving benchmark harness tests (ISSUE 7): the in-process load generator,
+the BENCH-record shape the CI gate consumes, and the lower-envelope gate
+logic itself (including the no-baseline-entry visible warning).
+
+The full four-workload suite runs in the dedicated CI ``serving`` job
+(``benchmarks/serving/harness.py --smoke --check``); here one cheap workload
+exercises the whole pipeline so tier-1 keeps the harness honest without
+paying the full load run.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+from heat_tpu.core import profiler
+from heat_tpu.testing import TestCase
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.serving import harness  # noqa: E402
+from benchmarks.serving.workloads import BUILDERS, build_workloads  # noqa: E402
+
+
+class TestServingHarness(TestCase):
+    def tearDown(self):
+        profiler.disable()
+        profiler.reset()
+        super().tearDown()
+
+    def test_closed_and_open_records(self):
+        collected = []
+        records, failed = harness.run(
+            smoke=True,
+            requests=6,
+            concurrency=2,
+            which=["sparse_matvec"],
+            emit=lambda line: collected.append(json.loads(line)),
+        )
+        self.assertFalse(failed)  # no baseline given: nothing can fail
+        self.assertEqual([r["mode"] for r in records], ["closed", "open"])
+        closed, open_ = records
+        self.assertEqual(closed["metric"], "serving_sparse_matvec_closed_rps")
+        self.assertEqual(closed["requests"], 6)
+        self.assertGreater(closed["value"], 0)
+        self.assertLessEqual(closed["p50_ms"], closed["p99_ms"])
+        self.assertLessEqual(closed["p99_ms"], closed["max_ms"])
+        # the profiler histogram snapshot rides along and agrees on the count
+        self.assertEqual(closed["latency_hist"]["count"], 6)
+        self.assertEqual(closed["profiler_schema"], profiler.SCHEMA)
+        self.assertIn("offered_rps", open_)
+        self.assertEqual(open_["latency_hist"]["count"], open_["requests"])
+        # histogram p50 and the exact nearest-rank p50 describe the same data
+        # (log-bucket resolution plus open-loop queueing skew — loose bound)
+        h50 = closed["latency_hist"]["p50_s"] * 1e3
+        self.assertLess(abs(h50 - closed["p50_ms"]) / closed["p50_ms"], 0.25)
+        self.assertEqual(len(collected), 2)
+
+    def test_trace_and_diag_artifacts(self):
+        import tempfile
+
+        d = tempfile.mkdtemp(prefix="ht_serving_")
+        self.addCleanup(lambda: __import__("shutil").rmtree(d, ignore_errors=True))
+        trace = os.path.join(d, "trace.json")
+        diag = os.path.join(d, "diag.json")
+        harness.run(
+            smoke=True, requests=4, concurrency=2, which=["sparse_matvec"],
+            trace_out=trace, diag_out=diag, emit=lambda line: None,
+        )
+        with open(trace) as f:
+            obj = json.load(f)
+        self.assertEqual(obj["schema"], profiler.TRACE_SCHEMA)
+        self.assertTrue(any(e.get("ph") == "B" for e in obj["traceEvents"]))
+        with open(diag) as f:
+            rep = json.load(f)
+        self.assertIn("profiler", rep)
+        self.assertIn(
+            "request.sparse_matvec.closed", rep["profiler"]["histograms"]
+        )
+
+    def test_gate_logic(self):
+        rec = {
+            "workload": "wl", "devices": 8, "value": 100.0,
+            "p50_ms": 10.0, "p99_ms": 20.0,
+        }
+        out = []
+        emit = lambda line: out.append(json.loads(line))  # noqa: E731
+        # healthy vs a loose envelope: no failure, no output
+        self.assertFalse(harness._gate_closed(
+            rec, {"min_rps": 50, "max_p50_ms": 40, "max_p99_ms": 80}, emit))
+        self.assertEqual(out, [])
+        # throughput collapse
+        self.assertTrue(harness._gate_closed(rec, {"min_rps": 200}, emit))
+        self.assertIn("below the baseline", out[-1]["error"])
+        # p99 blowout
+        self.assertTrue(harness._gate_closed(rec, {"max_p99_ms": 5}, emit))
+        self.assertIn("p99_ms", out[-1]["error"])
+        # no baseline entry: a VISIBLE warning, not a silent pass
+        self.assertFalse(harness._gate_closed(rec, None, emit))
+        self.assertIn("not gated", out[-1]["warning"])
+
+    def test_gate_failure_returned_not_raised(self):
+        # an impossible envelope: the in-process caller gets failed=True as a
+        # VALUE (the CLI, not run(), owns the non-zero exit)
+        out = []
+        records, failed = harness.run(
+            smoke=True, requests=4, concurrency=2, which=["sparse_matvec"],
+            check=True,
+            baseline={str(self.world_size): {
+                "sparse_matvec": {"min_rps": 1e12}
+            }},
+            emit=lambda line: out.append(json.loads(line)),
+        )
+        self.assertTrue(failed)
+        self.assertTrue(any("error" in rec for rec in out))
+        self.assertEqual(len(records), 2)
+
+    def test_baseline_covers_ci_matrix(self):
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(harness.__file__)),
+            "serving_baseline.json",
+        )
+        with open(path) as f:
+            baseline = json.load(f)
+        for devices in ("3", "8"):
+            self.assertIn(devices, baseline)
+            for name in BUILDERS:
+                envelope = baseline[devices].get(name)
+                self.assertIsNotNone(
+                    envelope, f"no envelope for {name} at {devices} devices"
+                )
+                self.assertGreater(envelope["min_rps"], 0)
+                self.assertGreater(envelope["max_p99_ms"],
+                                   envelope["max_p50_ms"])
+
+    def test_workloads_are_buildable_and_reentrant(self):
+        # the cheap workloads build and serve two sequential requests with
+        # bit-identical setup state (read-only after build)
+        for wl in build_workloads(smoke=True, which=["cdist_knn"]):
+            wl.fn(0)
+            wl.fn(1)
+
+    def test_percentile_nearest_rank(self):
+        lats = [i / 1000.0 for i in range(1, 101)]  # 1..100 ms
+        self.assertAlmostEqual(harness._percentile_ms(lats, 0.50), 51.0)
+        self.assertAlmostEqual(harness._percentile_ms(lats, 0.99), 99.0)
+        self.assertAlmostEqual(harness._percentile_ms(lats, 1.0), 100.0)
