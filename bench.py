@@ -361,6 +361,30 @@ def _bench_serving(devices: int = 8, timeout_s: float = 900.0) -> list:
             f"serving harness produced no records (rc={proc.returncode}): "
             f"{proc.stderr[-500:]}"
         )
+    # async-executor comparison (ISSUE 8): open-loop p99 async-on vs
+    # HEAT_TPU_ASYNC_DISPATCH=0 at the serialized arm's offered rates — the
+    # per-workload ratios plus the geomean summary ride extra_metrics so the
+    # round's JSON carries the scheduler's measured win even relay-down.
+    # Isolated: a failed comparison must not cost the round its records.
+    gate_script = os.path.join(os.path.dirname(script), "async_gate.py")
+    try:
+        proc = subprocess.run(
+            [sys.executable, gate_script, "--devices", str(devices), "--smoke"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        for line in proc.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                records.append(rec)
+    except Exception:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
     return records
 
 

@@ -1,0 +1,199 @@
+"""Async-executor serving gate: open-loop p99 async-on vs serialized.
+
+Runs the serving suite TWICE in one virtual mesh (so both arms share compiled
+programs and workload state — the comparison measures the executor, not
+compile luck):
+
+1. ``HEAT_TPU_ASYNC_DISPATCH=0`` — the lock-serialized executor. Its
+   measured per-workload open-loop offered rates are recorded.
+2. ``HEAT_TPU_ASYNC_DISPATCH=1`` — the async scheduler, driven at the SAME
+   offered rates (``run(open_rps=...)``), so the open-loop comparison is
+   queueing-theory-fair: identical arrival processes, different service
+   discipline.
+
+With ~30 open-loop samples per workload a p99 is close to the max sample, so
+a single scheduler hiccup on a shared CI box could flip one ratio. The gate
+therefore retries: a failing comparison re-runs once (fresh arms, fresh
+offered rates) and only a failure on BOTH attempts is a red gate — the same
+catch-collapses-not-jitter stance as the committed lower envelopes, without
+giving up the must-beat bar.
+
+Gate (``--check``), evaluated by :func:`evaluate`:
+
+- **closed-loop p50 must not regress**: async p50 <= serialized p50 x
+  ``P50_REGRESSION_MARGIN`` per workload (margin absorbs CI-box noise);
+- **open-loop p99 must beat the serialized executor overall**: the geometric
+  mean of per-workload ``async_p99 / serialized_p99`` ratios must be <= 1.0,
+  and no single workload may blow up past ``P99_BLOWUP_MARGIN``.
+
+Emits one JSON comparison record per workload (``serving_async_gate_*``) plus
+a summary; the summary's numbers are what ``serving_baseline.json``'s
+``_async_gate`` section records for the ROADMAP trail.
+
+Standalone::
+
+    python benchmarks/serving/async_gate.py --devices 8 --smoke --check
+"""
+
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from benchmarks.serving.harness import _bootstrap, run  # noqa: E402
+
+# Lower-envelope style margins: the gate catches an async executor that makes
+# serving WORSE, not run-to-run jitter on a noisy shared box.
+P50_REGRESSION_MARGIN = 1.30
+P99_BLOWUP_MARGIN = 1.50
+GEOMEAN_MAX = 1.0
+
+
+def _by_case(records):
+    return {(r["workload"], r["mode"]): r for r in records}
+
+
+def evaluate(records_serialized, records_async, emit=print):
+    """Compare the two arms' records; returns ``(comparisons, failed)``.
+
+    Pure record math (no jax, no environment) so tests can drive it with
+    canned records."""
+    ser = _by_case(records_serialized)
+    asy = _by_case(records_async)
+    comparisons, failed, ratios = [], False, []
+    for (name, mode), s in sorted(ser.items()):
+        if mode != "open":
+            continue
+        a = asy.get((name, "open"))
+        closed_s, closed_a = ser.get((name, "closed")), asy.get((name, "closed"))
+        if a is None or closed_s is None or closed_a is None:
+            emit(json.dumps({
+                "warning": f"async gate: workload {name!r} missing from one "
+                "arm; not compared"
+            }))
+            continue
+        p99_ratio = a["p99_ms"] / max(s["p99_ms"], 1e-9)
+        p50_ratio = closed_a["p50_ms"] / max(closed_s["p50_ms"], 1e-9)
+        ratios.append(p99_ratio)
+        rec = {
+            "metric": f"serving_async_gate_{name}",
+            "workload": name,
+            "offered_rps": s.get("offered_rps"),
+            "serialized_open_p99_ms": s["p99_ms"],
+            "async_open_p99_ms": a["p99_ms"],
+            "open_p99_ratio": round(p99_ratio, 4),
+            "serialized_closed_p50_ms": closed_s["p50_ms"],
+            "async_closed_p50_ms": closed_a["p50_ms"],
+            "closed_p50_ratio": round(p50_ratio, 4),
+        }
+        comparisons.append(rec)
+        emit(json.dumps(rec))
+        if p50_ratio > P50_REGRESSION_MARGIN:
+            failed = True
+            emit(json.dumps({
+                "error": f"{name}: async closed-loop p50 regressed "
+                f"{p50_ratio:.2f}x (margin {P50_REGRESSION_MARGIN}x)"
+            }))
+        if p99_ratio > P99_BLOWUP_MARGIN:
+            failed = True
+            emit(json.dumps({
+                "error": f"{name}: async open-loop p99 blew up "
+                f"{p99_ratio:.2f}x (margin {P99_BLOWUP_MARGIN}x)"
+            }))
+    if not ratios:
+        emit(json.dumps({"error": "async gate: no comparable open-loop records"}))
+        return comparisons, True
+    geomean = math.exp(sum(math.log(r) for r in ratios) / len(ratios))
+    summary = {
+        "metric": "serving_async_gate_summary",
+        "open_p99_geomean_ratio": round(geomean, 4),
+        "workloads": len(ratios),
+        "gate_max": GEOMEAN_MAX,
+    }
+    emit(json.dumps(summary))
+    comparisons.append(summary)
+    if geomean > GEOMEAN_MAX:
+        failed = True
+        emit(json.dumps({
+            "error": f"async open-loop p99 geomean ratio {geomean:.3f} > "
+            f"{GEOMEAN_MAX}: the async executor must beat the serialized one "
+            "at the recorded offered rates"
+        }))
+    return comparisons, failed
+
+
+def compare(smoke=True, requests=32, concurrency=4, open_fraction=0.85,
+            emit=print):
+    """Run both arms and return ``(comparisons, failed)``. ``open_fraction``
+    defaults HIGHER than the plain harness (0.85 vs 0.6): the serialized
+    executor must be pushed into its queueing regime for the comparison to
+    measure what the scheduler fixes."""
+    from heat_tpu.core import profiler
+
+    old = os.environ.get("HEAT_TPU_ASYNC_DISPATCH")
+    try:
+        profiler.reset()  # fresh histograms per comparison (retries included)
+        os.environ["HEAT_TPU_ASYNC_DISPATCH"] = "0"
+        emit(json.dumps({"info": "async gate arm 1/2: serialized executor"}))
+        records_ser, _ = run(
+            smoke=smoke, requests=requests, concurrency=concurrency,
+            open_fraction=open_fraction, emit=lambda s: None,
+        )
+        # pin arm 2 to arm 1's measured offered rates
+        open_rps = {
+            r["workload"]: r["offered_rps"]
+            for r in records_ser if r["mode"] == "open"
+        }
+        profiler.reset()  # arm 1's histograms must not fold into arm 2's
+        os.environ["HEAT_TPU_ASYNC_DISPATCH"] = "1"
+        emit(json.dumps({"info": "async gate arm 2/2: async executor",
+                         "offered_rps": open_rps}))
+        records_asy, _ = run(
+            smoke=smoke, requests=requests, concurrency=concurrency,
+            open_fraction=open_fraction, open_rps=open_rps, emit=lambda s: None,
+        )
+    finally:
+        if old is None:
+            os.environ.pop("HEAT_TPU_ASYNC_DISPATCH", None)
+        else:
+            os.environ["HEAT_TPU_ASYNC_DISPATCH"] = old
+    return evaluate(records_ser, records_asy, emit=emit)
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--requests", type=int, default=None)
+    parser.add_argument("--concurrency", type=int, default=4)
+    parser.add_argument("--open-fraction", type=float, default=0.85)
+    parser.add_argument("--check", action="store_true",
+                        help="exit non-zero when the async executor fails the "
+                        "p50-no-regression / p99-must-beat gates")
+    args = parser.parse_args()
+    _bootstrap(args.devices)
+    requests = args.requests or (48 if args.smoke else 128)
+    _, failed = compare(
+        smoke=args.smoke,
+        requests=requests,
+        concurrency=args.concurrency,
+        open_fraction=args.open_fraction,
+    )
+    if failed and args.check:
+        # one retry: a p99 over ~30 samples is nearly the max sample, so a
+        # single hiccup in either arm must not red a required CI gate — only
+        # failing BOTH fresh comparisons is a real regression
+        print(json.dumps({"info": "async gate failed once; retrying to rule "
+                          "out a single-run outlier"}))
+        _, failed = compare(
+            smoke=args.smoke,
+            requests=requests,
+            concurrency=args.concurrency,
+            open_fraction=args.open_fraction,
+        )
+    if args.check and failed:
+        sys.exit(1)

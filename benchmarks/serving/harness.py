@@ -1,7 +1,12 @@
 """End-to-end serving load harness: throughput and latency percentiles under
 concurrency, gated against a committed lower envelope.
 
-Drives the four workloads in ``workloads.py`` through two load shapes:
+Drives the four workloads in ``workloads.py`` — plus the **mixed** scenario,
+which interleaves all four request types through ONE shared worker pool
+(deterministic rotation), surfacing cross-signature executor-cache and
+dispatch-queue contention that the per-workload cases cannot; its records
+carry a ``per_workload`` p50/p99 breakdown next to the aggregate — through
+two load shapes:
 
 - **closed loop** — ``--concurrency`` worker threads issue requests
   back-to-back; measures the system's sustainable throughput and the service
@@ -70,6 +75,9 @@ def _bootstrap(devices: int) -> None:
         "HEAT_TPU_JIT_THRESHOLD",
         "HEAT_TPU_PROFILE",
         "HEAT_TPU_PROFILE_TRACE",
+        "HEAT_TPU_ASYNC_DISPATCH",
+        "HEAT_TPU_DISPATCH_QUEUE",
+        "HEAT_TPU_BATCH_MAX",
     ):
         env.pop(knob, None)
     flags = [
@@ -89,15 +97,19 @@ def _percentile_ms(latencies, q: float) -> float:
     return ordered[idx] * 1e3
 
 
-def _load_loop(profiler, wl, tag: str, n_requests: int, concurrency: int,
+def _load_loop(profiler, pick, n_requests: int, concurrency: int,
                arrivals=None):
-    """``concurrency`` worker threads drain ``n_requests``. With ``arrivals``
+    """``concurrency`` worker threads drain ``n_requests``. ``pick(i)`` names
+    request ``i``'s work as ``(fn, tag)`` — a single workload for the
+    per-workload cases, a deterministic rotation over all four for the mixed
+    scenario (ONE shared pool, interleaved request types). With ``arrivals``
     None this is the closed loop: requests issue back-to-back and latency is
     bare service time. With ``arrivals`` (a list of start offsets in seconds)
     it is the open loop: each request waits for its scheduled arrival and
     latency counts FROM that arrival, so queueing delay when all workers are
     busy is part of the number (an M/?/c queue's response time, not its bare
-    service time). Returns (per-request latencies [s], wall seconds)."""
+    service time). Returns (per-request ``(tag, latency_s)`` pairs, wall
+    seconds)."""
     counter = itertools.count()
     lat_lists = [[] for _ in range(concurrency)]
     errors = []
@@ -108,6 +120,7 @@ def _load_loop(profiler, wl, tag: str, n_requests: int, concurrency: int,
             i = next(counter)
             if i >= n_requests:
                 return
+            fn, tag = pick(i)
             if arrivals is None:
                 t0 = time.perf_counter()
             else:
@@ -117,11 +130,11 @@ def _load_loop(profiler, wl, tag: str, n_requests: int, concurrency: int,
                     time.sleep(t0 - now)
             try:
                 with profiler.request(tag):
-                    wl.fn(i)
+                    fn(i)
             except Exception as exc:  # a failed request fails the whole case
                 errors.append(exc)
                 return
-            lat_lists[slot].append(time.perf_counter() - t0)
+            lat_lists[slot].append((tag, time.perf_counter() - t0))
 
     threads = [
         threading.Thread(target=worker, args=(s,), daemon=True)
@@ -134,7 +147,7 @@ def _load_loop(profiler, wl, tag: str, n_requests: int, concurrency: int,
     wall = time.perf_counter() - start
     if errors:
         raise errors[0]
-    return [lat for lats in lat_lists for lat in lats], wall
+    return [pair for lats in lat_lists for pair in lats], wall
 
 
 def _poisson_arrivals(n_requests: int, rate_rps: float, seed: int = 0):
@@ -200,6 +213,42 @@ def _gate_closed(rec: dict, envelope, emit) -> bool:
     return failed
 
 
+def _merged_hist(profiler, tags):
+    """Fold the per-tag request histograms into one snapshot (the mixed
+    scenario's aggregate) using the histogram's exact bucket-count merge."""
+    snaps = profiler.histogram_snapshots()
+    merged = None
+    for tag in tags:
+        snap = snaps.get(f"request.{tag}")
+        if snap is None:
+            continue
+        h = profiler.Histogram.from_snapshot(snap)
+        merged = h if merged is None else merged.merge(h)
+    return merged.snapshot() if merged is not None else None
+
+
+def _per_workload_ms(pairs) -> dict:
+    """Per-request-type latency breakdown of a mixed run: ``{workload:
+    {requests, p50_ms, p99_ms}}``. Mixed tags are ``mixed.<workload>.<mode>``;
+    the middle component names the request type."""
+    by_type = {}
+    for tag, lat in pairs:
+        parts = tag.split(".")
+        name = parts[1] if len(parts) == 3 else parts[0]
+        by_type.setdefault(name, []).append(lat)
+    return {
+        name: {
+            "requests": len(lats),
+            "p50_ms": round(_percentile_ms(lats, 0.50), 3),
+            "p99_ms": round(_percentile_ms(lats, 0.99), 3),
+        }
+        for name, lats in sorted(by_type.items())
+    }
+
+
+MIXED = "mixed"
+
+
 def run(
     smoke: bool = True,
     requests: int = 32,
@@ -210,11 +259,16 @@ def run(
     baseline: dict = None,
     trace_out: str = None,
     diag_out: str = None,
+    open_rps: dict = None,
     emit=print,
 ):
     """Run the suite; returns ``(records, failed)`` — one record per
-    (workload, mode), and whether any closed-loop record broke its envelope
-    under ``check``/``baseline`` (``{str(devices): {workload: envelope}}``).
+    (workload, mode) plus the ``mixed`` interleaved scenario, and whether any
+    closed-loop record broke its envelope under ``check``/``baseline``
+    (``{str(devices): {workload: envelope}}``). ``open_rps`` pins a
+    workload's open-loop offered rate (``{workload: rps}``) instead of
+    deriving it from this run's closed-loop throughput — the async-executor
+    gate uses this to drive both executor modes at the SAME offered rate.
     The CLI turns ``failed`` into a non-zero exit; in-process callers get the
     gate verdict as a value instead of a ``SystemExit``."""
     import jax
@@ -224,6 +278,7 @@ def run(
 
     ndev = len(jax.devices())
     base_cases = (baseline or {}).get(str(ndev), {})
+    open_rps = open_rps or {}
     if baseline is not None and not base_cases:
         emit(json.dumps({
             "warning": f"baseline has no entry for {ndev} devices; "
@@ -233,34 +288,71 @@ def run(
     was_active = profiler.active()
     profiler.enable()
     records, failed = [], False
+
+    def suffixed(pick, mode):
+        def p(i):
+            fn, tag = pick(i)
+            return fn, f"{tag}.{mode}"
+
+        return p
+
+    def one_case(name, pick, tags):
+        nonlocal failed
+        tag_closed = [f"{t}.closed" for t in tags]
+        pairs, wall = _load_loop(
+            profiler, suffixed(pick, "closed"), requests, concurrency,
+        )
+        lats = [lat for _, lat in pairs]
+        hist = _merged_hist(profiler, tag_closed)
+        rec = _record(name, "closed", lats, wall, ndev, concurrency, hist)
+        if len(tags) > 1:
+            rec["per_workload"] = _per_workload_ms(pairs)
+        records.append(rec)
+        emit(json.dumps(rec))
+        if check or baseline:
+            failed |= _gate_closed(rec, base_cases.get(name), emit)
+
+        closed_rps = rec["value"]
+        offered = open_rps.get(name) or max(0.5, open_fraction * closed_rps)
+        n_open = max(8, (2 * requests) // 3)
+        tag_open = [f"{t}.open" for t in tags]
+        pairs, wall = _load_loop(
+            profiler, suffixed(pick, "open"), n_open, concurrency,
+            arrivals=_poisson_arrivals(n_open, offered),
+        )
+        lats = [lat for _, lat in pairs]
+        hist = _merged_hist(profiler, tag_open)
+        rec = _record(name, "open", lats, wall, ndev, concurrency, hist,
+                      offered_rps=offered)
+        if len(tags) > 1:
+            rec["per_workload"] = _per_workload_ms(pairs)
+        records.append(rec)
+        emit(json.dumps(rec))
+
     try:
-        for wl in build_workloads(smoke=smoke, which=which):
+        names = list(which) if which else None
+        run_mixed = names is None or MIXED in names
+        explicit = [n for n in (names or []) if n != MIXED]
+        # the mixed scenario interleaves ALL request types, so asking for it
+        # builds the full zoo even when only a subset runs standalone cases
+        build_names = None if (names is None or run_mixed) else explicit
+        wls = build_workloads(smoke=smoke, which=build_names)
+        for wl in wls:
             for i in range(WARMUP_REQUESTS):  # compile paths, uncounted
                 wl.fn(i)
-            tag_closed = f"{wl.name}.closed"
-            lats, wall = _load_loop(
-                profiler, wl, tag_closed, requests, concurrency
-            )
-            hist = profiler.histogram_snapshots().get(f"request.{tag_closed}")
-            rec = _record(wl.name, "closed", lats, wall, ndev, concurrency, hist)
-            records.append(rec)
-            emit(json.dumps(rec))
-            if check or baseline:
-                failed |= _gate_closed(rec, base_cases.get(wl.name), emit)
+        for wl in wls:
+            if names is not None and wl.name not in explicit:
+                continue
+            one_case(wl.name, lambda i, wl=wl: (wl.fn, wl.name), [wl.name])
+        if run_mixed and len(wls) > 1:
+            # the ROADMAP's interleaved scenario: all request types through
+            # ONE shared worker pool, rotating deterministically so every
+            # type's signatures contend in the same executor cache and queue
+            def pick(i, wls=wls):
+                wl = wls[i % len(wls)]
+                return wl.fn, f"{MIXED}.{wl.name}"
 
-            closed_rps = rec["value"]
-            offered = max(0.5, open_fraction * closed_rps)
-            n_open = max(8, (2 * requests) // 3)
-            tag_open = f"{wl.name}.open"
-            lats, wall = _load_loop(
-                profiler, wl, tag_open, n_open, concurrency,
-                arrivals=_poisson_arrivals(n_open, offered),
-            )
-            hist = profiler.histogram_snapshots().get(f"request.{tag_open}")
-            rec = _record(wl.name, "open", lats, wall, ndev, concurrency, hist,
-                          offered_rps=offered)
-            records.append(rec)
-            emit(json.dumps(rec))
+            one_case(MIXED, pick, [f"{MIXED}.{wl.name}" for wl in wls])
         if trace_out:
             profiler.dump_trace(trace_out)
             emit(json.dumps({"artifact": "perfetto_trace", "path": trace_out}))

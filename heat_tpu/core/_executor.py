@@ -64,10 +64,31 @@ cached leaf. Three more things ride the same linearisation:
   so single-consumer chains compile byte-identical HLO to the single-output
   executor.
 
+**Async multi-tenant dispatch.** Forces used to run entirely under the global
+executor lock — linearisation, donation decisions, AND the program call — so
+concurrent serving requests serialised on every force. With
+``HEAT_TPU_ASYNC_DISPATCH`` (default on, ``=0`` restores the serialized path
+bit-for-bit) a force only *plans* under the lock: the graph is linearised, the
+donation/emission decisions are made, every emitted node's ``Deferred.value``
+is filled with a :class:`~._scheduler.PendingValue` dispatch-done future, and
+the buffers the call will touch are claimed in the per-buffer ownership
+registry (donation epochs — the narrow thing the global lock actually
+protected). The *execution* then happens outside the lock: inline on the
+submitting thread when nobody else is dispatching, or parked in the
+:class:`~._scheduler.DispatchScheduler`'s bounded per-tenant queue, where a
+scheduler thread drains it round-robin across request tags and **batches**
+concurrent same-signature forces into one ``jax.vmap``-derived program variant
+(:meth:`_Program.call_batched`). A full queue is backpressure: the submitter
+retries under the ``executor.queue`` ``ht.resilience`` policy and, exhausted,
+runs inline — work is never dropped. Failures inside a queued execution take
+the same :func:`fallback_after_failure` + ``replay_eager`` path as the
+serialized executor, so chaos plans cannot lose data by firing mid-queue.
+
 Escape hatch: ``HEAT_TPU_EAGER_DISPATCH=1`` disables the executor entirely and
 restores the fully eager dispatch path for debugging. Introspection:
-:func:`executor_stats` (hits / misses / retraces / cache size) backs the tests
-and the ``benchmarks/cb/dispatch.py`` microbenchmark.
+:func:`executor_stats` (hits / misses / retraces / cache size / queue + batch
+telemetry) backs the tests and the ``benchmarks/cb/dispatch.py``
+microbenchmark.
 """
 
 from __future__ import annotations
@@ -85,13 +106,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import diagnostics, profiler, resilience
+from . import _scheduler, diagnostics, profiler, resilience
+from ._scheduler import PendingValue
 
 __all__ = [
     "executor_stats",
     "reset_executor_stats",
     "clear_executor_cache",
     "executor_enabled",
+    "async_dispatch_enabled",
 ]
 
 # Retrace-storm guard: per-call lambdas (now hoisted where we control them) or
@@ -108,38 +131,135 @@ UNSUPPORTED = object()
 executor cannot stage; the wrapper takes the eager path."""
 
 
-class _Stats:
-    # Concurrency note (serving-harness audit): most tallies are incremented
-    # under the executor lock (lookup, the whole fused force); the exceptions
-    # — `retraces` inside a traced body, the memoised-read fast path of
-    # `Deferred.force` — are RELAXED by design: a racing += may undercount,
-    # never corrupt, and locking them would put an acquire on paths that are
-    # documented as costing one attribute read / nothing.
-    __slots__ = (
-        "hits", "misses", "retraces",
-        # multi-output fused-graph telemetry (see _force_graph)
-        "interior_outputs", "reexec_avoided", "reexecuted",
-        "cse_hits", "donated_bytes",
-        # failure hardening: compiled programs whose compile/execute failed and
-        # whose call fell back to the eager path (see fallback_after_failure)
-        "eager_fallbacks",
-    )
+# Telemetry tallies. These used to be one shared object with RELAXED racing
+# `+=` on a few hot paths (a racing increment could undercount) — acceptable
+# when the only concurrency was test threads, wrong for a scheduler that
+# executes forces on worker + scheduler threads all day. They are now
+# PER-THREAD accumulator cells merged at report time: every `_stats.field += n`
+# lands in the calling thread's private cell (no lock, no race, exact), and
+# `executor_stats()` sums the cells. Cells of finished threads are folded into
+# a retired cell so thread churn cannot grow the registry without bound.
+_STAT_FIELDS = (
+    "hits", "misses", "retraces",
+    # multi-output fused-graph telemetry (see the force paths)
+    "interior_outputs", "reexec_avoided", "reexecuted",
+    "cse_hits", "donated_bytes",
+    # failure hardening: compiled programs whose compile/execute failed and
+    # whose call fell back to the eager path (see fallback_after_failure)
+    "eager_fallbacks",
+    # async executor telemetry: wall nanoseconds threads spent BLOCKED on the
+    # executor lock, and leaf donations refused by the per-buffer ownership
+    # registry (an in-flight reader or a standing claim held the buffer)
+    "lock_wait_ns", "donation_refusals",
+)
+_STAT_FIELD_SET = frozenset(_STAT_FIELDS)
+
+
+class _StatsCell:
+    __slots__ = _STAT_FIELDS + ("_thread",)
 
     def __init__(self):
-        self.hits = 0
-        self.misses = 0
-        self.retraces = 0
-        self.interior_outputs = 0
-        self.reexec_avoided = 0
-        self.reexecuted = 0
-        self.cse_hits = 0
-        self.donated_bytes = 0
-        self.eager_fallbacks = 0
+        for field in _STAT_FIELDS:
+            setattr(self, field, 0)
+        self._thread = weakref.ref(threading.current_thread())
+
+
+class _Stats:
+    """Per-thread stat cells behind the familiar ``_stats.field += n`` shape.
+
+    Attribute reads/writes of a stat field resolve to the calling thread's
+    cell (created on first touch), so increments are exact without any lock.
+    :meth:`totals` merges every cell (minus the reset baseline); dead threads'
+    cells are folded into ``_retired`` during the merge."""
+
+    def __init__(self):
+        object.__setattr__(self, "_local", threading.local())
+        object.__setattr__(self, "_cells", [])
+        object.__setattr__(self, "_cells_lock", threading.Lock())
+        object.__setattr__(self, "_retired", {f: 0 for f in _STAT_FIELDS})
+        object.__setattr__(self, "_base", {f: 0 for f in _STAT_FIELDS})
+
+    def _cell(self) -> _StatsCell:
+        cell = getattr(self._local, "cell", None)
+        if cell is None:
+            cell = _StatsCell()
+            with self._cells_lock:
+                self._cells.append(cell)
+            self._local.cell = cell
+        return cell
+
+    def __getattr__(self, name):
+        if name in _STAT_FIELD_SET:
+            return getattr(self._cell(), name)
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name in _STAT_FIELD_SET:
+            setattr(self._cell(), name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def _raw_totals_locked(self) -> dict:
+        live = []
+        for cell in self._cells:
+            th = cell._thread()
+            if th is None or not th.is_alive():
+                # the owning thread can no longer increment: fold and drop
+                for f in _STAT_FIELDS:
+                    self._retired[f] += getattr(cell, f)
+            else:
+                live.append(cell)
+        self._cells[:] = live
+        totals = dict(self._retired)
+        for cell in live:
+            for f in _STAT_FIELDS:
+                totals[f] += getattr(cell, f)
+        return totals
+
+    def totals(self) -> dict:
+        with self._cells_lock:
+            raw = self._raw_totals_locked()
+        return {f: raw[f] - self._base[f] for f in _STAT_FIELDS}
+
+    def total(self, name: str) -> int:
+        return self.totals()[name]
+
+    def reset(self) -> None:
+        # a baseline snapshot, not a zeroing write: concurrent increments on
+        # other threads are never lost, they just count toward the next window
+        with self._cells_lock:
+            raw = self._raw_totals_locked()
+            self._base.update(raw)
 
 
 _stats = _Stats()
 _programs: "OrderedDict[Any, Any]" = OrderedDict()
 _lock = threading.RLock()
+
+
+def _lock_acquire() -> None:
+    """Acquire the executor lock, charging any blocked wait to the calling
+    thread's ``lock_wait_ns`` tally (the uncontended path is one try-acquire)."""
+    if _lock.acquire(blocking=False):
+        return
+    t0 = time.perf_counter_ns()
+    _lock.acquire()
+    _stats.lock_wait_ns += time.perf_counter_ns() - t0
+
+
+class _TimedLock:
+    """``with _tlock:`` — the executor lock with contention accounting."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        _lock_acquire()
+
+    def __exit__(self, *exc):
+        _lock.release()
+
+
+_tlock = _TimedLock()
 
 # Warm-up counts for signatures seen but not yet compiled (jit threshold > 1).
 _seen: Dict[Any, int] = {}
@@ -181,6 +301,109 @@ def executor_enabled() -> bool:
     return _single_controller
 
 
+def async_dispatch_enabled() -> bool:
+    """Whether deferred-graph forces take the async scheduler path.
+
+    ``HEAT_TPU_ASYNC_DISPATCH=0`` restores the fully lock-serialized force
+    (plan AND program call under the executor lock, direct memoisation — the
+    pre-scheduler executor, bit for bit). Read per force so tests and the
+    serving async-gate can flip it in-process."""
+    return os.environ.get("HEAT_TPU_ASYNC_DISPATCH", "1") != "0"
+
+
+def queue_bound() -> int:
+    """Dispatch-queue capacity (``HEAT_TPU_DISPATCH_QUEUE``, default 256).
+    A submit against a full queue is backpressure: retried under the
+    ``executor.queue`` resilience policy, then executed inline."""
+    try:
+        return max(1, int(os.environ.get("HEAT_TPU_DISPATCH_QUEUE", "256")))
+    except ValueError:
+        return 256
+
+
+def batch_max() -> int:
+    """Cross-request batching width cap (``HEAT_TPU_BATCH_MAX``, default 8;
+    ``1`` disables batching). Widths are bucketed to powers of two up to this
+    cap so each program compiles a bounded set of batched variants."""
+    try:
+        return max(1, int(os.environ.get("HEAT_TPU_BATCH_MAX", "8")))
+    except ValueError:
+        return 8
+
+
+# ------------------------------------------------------- per-buffer ownership
+# Donation epochs: the narrow invariant the global force lock actually
+# protected is "a buffer donated to one program call is never an operand of a
+# concurrent call". With execution moved outside the lock, that invariant
+# lives here instead: a planned call REGISTERS its leaf buffers (reads) and
+# CLAIMS its donation candidates under _own_lock before the executor lock is
+# released; a claim is refused — the call simply runs undonated, donation is
+# an optimisation, never a dependency — when any other in-flight call still
+# reads the buffer or holds a standing claim. Non-donating forces only touch
+# this tiny lock for the register/release pair and never contend on donation.
+
+_own_lock = threading.Lock()
+_inflight_reads: Dict[int, int] = {}   # id(jax.Array) -> in-flight reading calls
+_donation_claims: Dict[int, int] = {}  # id(jax.Array) -> claim epoch
+_donation_epoch = 0
+
+
+def _acquire_buffers(read_leaves, donate_leaves):
+    """Register one planned call's buffer ownership. Returns the subset of
+    ``donate_leaves`` whose claims were GRANTED (the rest count as
+    ``donation_refusals`` and run undonated). Call :func:`_release_buffers`
+    with the same lists when the call completes."""
+    global _donation_epoch
+    granted = []
+    with _own_lock:
+        _donation_epoch += 1
+        for leaf in donate_leaves:
+            i = id(leaf)
+            if _inflight_reads.get(i) or i in _donation_claims:
+                _stats.donation_refusals += 1
+                read_leaves.append(leaf)  # demoted to a plain read
+            else:
+                _donation_claims[i] = _donation_epoch
+                granted.append(leaf)
+        for leaf in read_leaves:
+            i = id(leaf)
+            _inflight_reads[i] = _inflight_reads.get(i, 0) + 1
+    if diagnostics._enabled and len(granted) != len(donate_leaves):
+        diagnostics.counter(
+            "executor.donation_refused", len(donate_leaves) - len(granted)
+        )
+    return granted
+
+
+def _release_buffers(read_leaves, granted) -> None:
+    with _own_lock:
+        for leaf in read_leaves:
+            i = id(leaf)
+            n = _inflight_reads.get(i, 0) - 1
+            if n > 0:
+                _inflight_reads[i] = n
+            else:
+                _inflight_reads.pop(i, None)
+        for leaf in granted:
+            _donation_claims.pop(id(leaf), None)
+
+
+# ------------------------------------------------------------ dispatch queue
+_dispatch_scheduler: Optional[_scheduler.DispatchScheduler] = None
+
+
+def _get_scheduler() -> _scheduler.DispatchScheduler:
+    global _dispatch_scheduler
+    sched = _dispatch_scheduler
+    if sched is None:
+        with _lock:
+            sched = _dispatch_scheduler
+            if sched is None:
+                sched = _scheduler.DispatchScheduler(_execute_batch)
+                _dispatch_scheduler = sched
+    return sched
+
+
 def executor_stats(top: int = 0) -> dict:
     """Cache introspection: ``hits`` / ``misses`` (signature-table lookups),
     ``retraces`` (times a program body was actually traced — 0 between two
@@ -217,6 +440,18 @@ def executor_stats(top: int = 0) -> dict:
       path after repeated failures, each mapped to the explained reason
       (phase, failure count, exception).
 
+    Async-scheduler counters (all since the last reset; see
+    :mod:`._scheduler` and ``doc/source/performance.rst``):
+
+    - ``queue_depth_peak`` — deepest the bounded dispatch queue has been.
+    - ``batched_requests`` — forces that rode a cross-request batched
+      execution (one ``jax.vmap``-derived program call for N requests).
+    - ``batch_width_hist`` — ``{width: count}`` of batched executions.
+    - ``lock_wait_ns`` — wall nanoseconds threads spent blocked acquiring the
+      executor lock (the contention the async path exists to remove).
+    - ``donation_refusals`` — leaf donations the per-buffer ownership registry
+      refused because another in-flight call still owned the buffer.
+
     ``top > 0`` adds ``top_signatures``: the N hottest compiled programs by
     lifetime replay count, each as ``{"label", "hits", "compile_s"}`` —
     ``label`` names the dispatch family and operation (``"defer:add..add[64]"``,
@@ -224,18 +459,37 @@ def executor_stats(top: int = 0) -> dict:
     reset by :func:`reset_executor_stats` — they live with the program), and
     ``compile_s`` is the first-call wall time (trace + XLA compile + first
     execution)."""
+    totals = _stats.totals()
     stats = {
-        "hits": _stats.hits,
-        "misses": _stats.misses,
-        "retraces": _stats.retraces,
+        "hits": totals["hits"],
+        "misses": totals["misses"],
+        "retraces": totals["retraces"],
         "programs": len(_programs),
-        "interior_outputs": _stats.interior_outputs,
-        "reexec_avoided": _stats.reexec_avoided,
-        "reexecuted": _stats.reexecuted,
-        "cse_hits": _stats.cse_hits,
-        "donated_bytes": _stats.donated_bytes,
-        "eager_fallbacks": _stats.eager_fallbacks,
+        "interior_outputs": totals["interior_outputs"],
+        "reexec_avoided": totals["reexec_avoided"],
+        "reexecuted": totals["reexecuted"],
+        "cse_hits": totals["cse_hits"],
+        "donated_bytes": totals["donated_bytes"],
+        "eager_fallbacks": totals["eager_fallbacks"],
+        "lock_wait_ns": totals["lock_wait_ns"],
+        "donation_refusals": totals["donation_refusals"],
     }
+    sched = _dispatch_scheduler
+    if sched is not None:
+        sstats = sched.stats()
+        stats["queue_depth_peak"] = sstats["queue_depth_peak"]
+        stats["batched_requests"] = sstats["batched_requests"]
+        stats["batch_width_hist"] = sstats["batch_width_hist"]
+        stats["queue_full_events"] = sstats["queue_full_events"]
+        stats["inline_dispatches"] = sstats["inline_runs"]
+        stats["queued_dispatches"] = sstats["submitted"]
+    else:
+        stats["queue_depth_peak"] = 0
+        stats["batched_requests"] = 0
+        stats["batch_width_hist"] = {}
+        stats["queue_full_events"] = 0
+        stats["inline_dispatches"] = 0
+        stats["queued_dispatches"] = 0
     with _lock:
         stats["quarantined"] = dict(_quarantined)
     if top > 0:
@@ -258,21 +512,17 @@ def executor_stats(top: int = 0) -> dict:
 
 
 def reset_executor_stats() -> None:
-    """Zero the GLOBAL counters (``hits`` / ``misses`` / ``retraces`` and the
+    """Zero the GLOBAL counters (``hits`` / ``misses`` / ``retraces``, the
     multi-output fused-graph tallies ``interior_outputs`` / ``reexec_avoided``
-    / ``reexecuted`` / ``cse_hits`` / ``donated_bytes``). The program table is
-    kept, and so are the per-signature lifetime tallies behind
-    ``executor_stats(top=N)`` — those are properties of the cached programs and
-    only drop with them (:func:`clear_executor_cache`)."""
-    _stats.hits = 0
-    _stats.misses = 0
-    _stats.retraces = 0
-    _stats.interior_outputs = 0
-    _stats.reexec_avoided = 0
-    _stats.reexecuted = 0
-    _stats.cse_hits = 0
-    _stats.donated_bytes = 0
-    _stats.eager_fallbacks = 0
+    / ``reexecuted`` / ``cse_hits`` / ``donated_bytes``, and the async
+    scheduler/lock telemetry). The program table is kept, and so are the
+    per-signature lifetime tallies behind ``executor_stats(top=N)`` — those
+    are properties of the cached programs and only drop with them
+    (:func:`clear_executor_cache`)."""
+    _stats.reset()
+    sched = _dispatch_scheduler
+    if sched is not None:
+        sched.reset_stats()
 
 
 def clear_executor_cache() -> None:
@@ -396,6 +646,11 @@ def operand_sig(x):
     promotion semantics differ)."""
     if isinstance(x, jax.Array):
         return (x.shape, x.dtype)
+    if isinstance(x, PendingValue):
+        # a dispatch-done future from an in-flight async force: signatures key
+        # on its (known) physical aval exactly like the concrete array it
+        # resolves to, so the program replays regardless of arrival order
+        return (x.shape, x.dtype)
     if isinstance(x, np.ndarray):
         return (x.shape, x.dtype, "np")
     if isinstance(x, (np.number, np.bool_)):
@@ -435,7 +690,7 @@ class _Program:
     __slots__ = (
         "body", "out_shardings", "donate_index", "meta",
         "label", "hits", "compile_s", "arg_specs", "_plain", "_donating",
-        "_variants", "failures", "proven",
+        "_variants", "_batched", "failures", "proven",
     )
 
     def __init__(self, body, out_shardings, donate_index, meta):
@@ -450,6 +705,7 @@ class _Program:
         self._plain = None
         self._donating = None
         self._variants = None
+        self._batched = None  # width -> jitted vmap variant (cross-request batching)
         self.failures = 0   # compile/execute failures (fallback_after_failure)
         self.proven = False  # at least one call of any variant has succeeded
 
@@ -493,7 +749,7 @@ class _Program:
             # build the jit variant under the executor lock: two threads racing
             # the first call of one program must share ONE jit object (else both
             # trace — double-counted retraces/compile events, wasted compile)
-            with _lock:
+            with _tlock:
                 if donate_leaves:
                     if self._variants is None:
                         self._variants = {}
@@ -571,6 +827,83 @@ class _Program:
         self.proven = True
         return out
 
+    def call_batched(self, width: int, array_pos: Tuple[int, ...],
+                     scalar_pos: Tuple[int, ...], flat_arrays: Sequence,
+                     scalars: Sequence) -> Tuple:
+        """Run ``width`` same-signature calls as ONE batched program.
+
+        The batched variant stacks each leaf position's ``width`` buffers
+        inside the traced body (no eager per-leaf stack dispatch), maps the
+        original program body over the stacked leading axis with ``jax.vmap``
+        — deferred-graph bodies are strictly elementwise, so every lane
+        computes bit-identically to its single-item call — and returns the
+        un-stacked per-item outputs as separate, per-item-sharded results.
+        ``flat_arrays`` is item-major (item0's arrays, item1's, …); ``scalars``
+        are the scalar leaves shared by every item in the group (identity is
+        part of the batch key). Returns a flat tuple, item-major, ``n_outs``
+        entries per item. Variants are cached per width; widths are bucketed
+        to powers of two by the scheduler, so the set stays bounded."""
+        fn = None if self._batched is None else self._batched.get(width)
+        first = fn is None
+        if first:
+            with _tlock:
+                if self._batched is None:
+                    self._batched = {}
+                fn = self._batched.get(width)
+                first = fn is None
+                if first and resilience._armed:
+                    resilience.maybe_fault("executor.compile")
+                if first:
+                    body = self._traced()
+                    n_arr = len(array_pos)
+
+                    def batched_body(*flat):
+                        arrs = flat[: width * n_arr]
+                        scal = flat[width * n_arr:]
+
+                        def one(*xs):
+                            argv = [None] * (len(array_pos) + len(scalar_pos))
+                            for k, j in enumerate(array_pos):
+                                argv[j] = xs[k]
+                            for k, j in enumerate(scalar_pos):
+                                argv[j] = scal[k]
+                            return body(*argv)
+
+                        stacked = tuple(
+                            jnp.stack([arrs[i * n_arr + k] for i in range(width)])
+                            for k in range(n_arr)
+                        )
+                        outs = jax.vmap(one)(*stacked)
+                        if not isinstance(outs, tuple):
+                            outs = (outs,)
+                        return tuple(o[i] for i in range(width) for o in outs)
+
+                    inner = (
+                        self.out_shardings
+                        if isinstance(self.out_shardings, tuple)
+                        else (self.out_shardings,)
+                    )
+                    fn = self._batched[width] = jax.jit(
+                        batched_body, out_shardings=inner * width
+                    )
+            t0 = time.perf_counter()
+        if resilience._armed:
+            resilience.maybe_fault("executor.execute")
+        args = tuple(flat_arrays) + tuple(scalars)
+        label = f"{self.label or 'program'}[x{width}]"
+        if profiler._active:
+            with profiler.scope("compile" if first else "execute", label):
+                out = fn(*args)
+        else:
+            out = fn(*args)
+        if first:
+            dt = time.perf_counter() - t0
+            self.compile_s += dt
+            if diagnostics._enabled:
+                diagnostics.record_compile(label, dt)
+        self.proven = True
+        return out
+
 
 def lookup(key, build: Callable[[], Any], label: Optional[str] = None) -> Optional[_Program]:
     """The cached :class:`_Program` for ``key``, building it on miss.
@@ -583,8 +916,9 @@ def lookup(key, build: Callable[[], Any], label: Optional[str] = None) -> Option
     # the whole lookup holds the lock: signature keys hash Python-level objects
     # (the Mesh), so even the read path could yield the GIL mid-mutation of the
     # shared OrderedDict; an uncontended RLock costs ~100 ns against a ~40 µs
-    # replay, and compiles were already serialised
-    with _lock:
+    # replay, and compiles were already serialised. Timed: blocked waits land
+    # in the lock_wait_ns tally.
+    with _tlock:
         entry = _programs.get(key)
         if entry is not None:
             _stats.hits += 1
@@ -776,22 +1110,50 @@ class Deferred:
         """Materialise this node (and everything it transitively needs) as one
         signature-cached program execution. A value already memoised — by an
         earlier force that emitted this node as an interior output — is
-        returned as-is: the whole subchain's re-execution was avoided.
+        returned as-is: the whole subchain's re-execution was avoided. A
+        :class:`~._scheduler.PendingValue` — an async force of this node is
+        already in flight — is resolved: the wait covers program *dispatch*
+        only (the resolved jax.Array is itself asynchronous on device).
 
-        Check-then-force is atomic under the executor lock: two threads racing
-        the same node's first force used to merely duplicate work, but leaf
-        donation would let the winner invalidate buffers the loser's already-
-        linearised plan still references. XLA dispatch is async, so the lock
-        covers launch bookkeeping, not device execution."""
-        if self.value is None:
-            with _lock:
-                if self.value is None:
-                    _force_graph((self,))
-                else:
-                    _stats.reexec_avoided += 1
+        Check-then-force is atomic under the executor lock (the force paths
+        re-check every root after acquiring it): two threads racing the same
+        node's first force used to merely duplicate work, but leaf donation
+        would let the winner invalidate buffers the loser's already-linearised
+        plan still references. Pending-value resolution always happens OUTSIDE
+        the lock — the executing side may need the lock to finish."""
+        v = self.value
+        if v is None or (isinstance(v, PendingValue) and v.failed()):
+            if v is not None:
+                self.value = None  # failed dispatch: this force is the retry
+            _force_graph((self,))
+            v = self.value
+            if v is None:
+                # the dispatch failed terminally between our force and this
+                # read (fail() delivered the error to its own waiters): retry
+                # once more from a clean slate rather than returning nothing
+                _force_graph((self,))
+                v = self.value
         else:
             _stats.reexec_avoided += 1
-        return self.value
+        if isinstance(v, PendingValue):
+            try:
+                if profiler._active and not v.done():
+                    # make the queueing + dispatch wait visible on the
+                    # request's trace track — this is exactly the latency the
+                    # async queue adds under load
+                    with profiler.scope("wait", "force:queue_wait", req=self.req):
+                        v = v.resolve()
+                else:
+                    v = v.resolve()
+            except BaseException:
+                # surface the dispatch failure to THIS reader, but clear the
+                # failed future first so the next force retries — the
+                # serialized path raises afresh on every read too
+                if self.value is v:
+                    self.value = None
+                raise
+            self.value = v
+        return v
 
 
 def note_wrapped(node: Deferred, holder) -> None:
@@ -904,7 +1266,10 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
                 pending.append(v)
         _force_graph(tuple(pending))
         operands = tuple(
-            ("a", v.value) if kind == "d" and v.value is not None else (kind, v)
+            ("a", v.value)
+            if kind == "d" and v.value is not None
+            and not isinstance(v.value, PendingValue)
+            else (kind, v)
             for kind, v in operands
         )
         size = 1
@@ -939,9 +1304,93 @@ def _pending_count(operands, cap: int) -> int:
 
 
 def _force_graph(roots: Tuple[Deferred, ...]) -> None:
-    """Linearise the graph under ``roots``, look up / compile ONE (possibly
-    multi-output) program, run it, and memoise every emitted value into its
-    node's ``Deferred.value``.
+    """Force the graph under ``roots``: linearise it, look up / compile ONE
+    (possibly multi-output) program, execute it, and memoise every emitted
+    value into its node's ``Deferred.value``.
+
+    Two execution shapes share one planner (:func:`_linearise`):
+
+    - **serialized** (``HEAT_TPU_ASYNC_DISPATCH=0``): plan AND program call
+      run under the executor lock and values are memoised before the lock
+      drops — the pre-scheduler executor, preserved bit for bit;
+    - **async** (the default): only the *plan* holds the lock — linearisation,
+      donation/emission decisions, per-buffer ownership claims, and
+      :class:`~._scheduler.PendingValue` futures installed into every emitted
+      node. The program call runs outside the lock: inline on this thread when
+      nobody else is dispatching, otherwise through the fair bounded dispatch
+      queue, where concurrent same-signature forces batch into one
+      ``jax.vmap``-derived program variant.
+    """
+    if profiler._active:
+        # attribute the force to the ambient request, falling back to the id a
+        # root captured at defer time (the chain may be forced from another
+        # thread, after the request scope that built it closed). The scope
+        # spans planning + submission (and the whole execution when it runs
+        # inline); a QUEUED dispatch's wait surfaces as its own
+        # "force:queue_wait" slice where the reader resolves the future.
+        req = next((r.req for r in roots if r.req is not None), None)
+        with profiler.scope(
+            "force", f"force:{_op_label(roots[0].operation)}", req=req
+        ):
+            _force_graph_inner(roots)
+        return
+    _force_graph_inner(roots)
+
+
+def _force_graph_inner(roots: Tuple[Deferred, ...]) -> None:
+    if async_dispatch_enabled():
+        _force_async(roots)
+        return
+    # serialized legacy path: settle any dispatch-done futures an earlier
+    # async force left behind BEFORE taking the lock (the in-flight executor
+    # may need the lock to finish — waiting under it would deadlock), then
+    # run the whole force under the lock exactly as the pre-scheduler
+    # executor did.
+    _settle_pending_nodes(roots)
+    with _tlock:
+        _force_sync_locked(roots)
+
+
+def _settle_pending_nodes(roots) -> None:
+    """Resolve every in-flight :class:`PendingValue` reachable under ``roots``
+    into its concrete value (used when switching async -> serialized with
+    forces still in flight). Never called while holding the executor lock."""
+    stack = list(roots)
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        v = node.value
+        if isinstance(v, PendingValue):
+            try:
+                node.value = v.resolve()
+            except BaseException:
+                node.value = None  # failed dispatch: the next force retries
+                raise
+        elif v is None:
+            stack.extend(v2 for kind, v2 in node.operands if kind == "d")
+
+
+class _ForcePlan:
+    """Everything :func:`_linearise` decided about one force — shared by the
+    serialized and async executors, and carried (via closures) by queued
+    :class:`~._scheduler.WorkItem`\\ s until their dispatch completes."""
+
+    __slots__ = (
+        "root", "leaves", "leaf_donatable", "plan", "entry_sig",
+        "entry_nodes", "arefs", "out_idxs", "root_idxs", "single", "key",
+        "label", "gshape", "split", "padded", "out_shardings",
+    )
+
+
+def _linearise(roots: Tuple[Deferred, ...]) -> Optional[_ForcePlan]:
+    """Linearise the graph under ``roots`` into a :class:`_ForcePlan`:
+    evaluation-ordered plan entries, deduplicated leaves, the program
+    signature key, and the emission/donation bookkeeping. Runs under the
+    executor lock. Roots already forced (or with a dispatch in flight) are
+    dropped — ``None`` means there is nothing left to execute.
 
     The structural signature keys on per-node operation identity + kwargs, the
     leaf avals, the exact sharing pattern (a leaf or node referenced twice maps
@@ -961,27 +1410,12 @@ def _force_graph(roots: Tuple[Deferred, ...]) -> None:
     externally-reachable entry is memoised, no future force can re-read this
     program's leaves, so a leaf whose refcount proves the plan is its only
     reader (``sanitation.sanitize_leaf_donation``) can be donated."""
-    # the whole force runs under the executor lock: the linearised plan, the
-    # refcount-based emission/donation decisions, and the donate-variant cap
-    # must be atomic against other threads' forces — a concurrently donated
-    # leaf must never reach a program call. RLock: re-entrant from
-    # Deferred.force and _Program.__call__'s first-call build.
-    if profiler._active:
-        # attribute the force to the ambient request, falling back to the id a
-        # root captured at defer time (the chain may be forced from another
-        # thread, after the request scope that built it closed)
-        req = next((r.req for r in roots if r.req is not None), None)
-        with profiler.scope(
-            "force", f"force:{_op_label(roots[0].operation)}", req=req
-        ):
-            with _lock:
-                _force_graph_locked(roots)
-        return
-    with _lock:
-        _force_graph_locked(roots)
-
-
-def _force_graph_locked(roots: Tuple[Deferred, ...]) -> None:
+    live = tuple(r for r in roots if r.value is None)
+    if len(live) != len(roots):
+        _stats.reexec_avoided += len(roots) - len(live)
+    if not live:
+        return None
+    roots = live
     leaves: list = []
     leaf_index: Dict[Any, int] = {}
     leaf_donatable: List[bool] = []
@@ -997,7 +1431,10 @@ def _force_graph_locked(roots: Tuple[Deferred, ...]) -> None:
     cse_hits = 0
 
     def leaf_ref(value, donatable: bool):
-        if isinstance(value, jax.Array):
+        if isinstance(value, jax.Array) or isinstance(value, PendingValue):
+            # a PendingValue is the unique stand-in for a buffer an in-flight
+            # force will deliver: identity-keyed like the array it becomes,
+            # never donatable (its memo must survive this program)
             k = ("a", id(value))
         else:
             try:
@@ -1027,13 +1464,19 @@ def _force_graph_locked(roots: Tuple[Deferred, ...]) -> None:
         for kind, v in node.operands:
             if kind == "d":
                 drefs[id(v)] = drefs.get(id(v), 0) + 1
-                if v.value is None:
+                vv = v.value
+                if vv is not None and isinstance(vv, PendingValue) and vv.failed():
+                    # a dispatch that failed terminally: re-plan the subchain
+                    # (this force is the retry the serialized path would run)
+                    v.value = vv = None
+                if vv is None:
                     refs.append(visit(v))
                 else:
-                    # a memoised interior value from an earlier force: consume
-                    # it as a plain leaf — its whole subchain is NOT replayed
+                    # a memoised interior value from an earlier force (or its
+                    # in-flight PendingValue): consume it as a plain leaf —
+                    # its whole subchain is NOT replayed
                     memo_hits += 1
-                    refs.append(leaf_ref(v.value, False))
+                    refs.append(leaf_ref(vv, False))
             elif kind == "a":
                 arefs[id(v)] = arefs.get(id(v), 0) + 1
                 refs.append(leaf_ref(v, True))
@@ -1115,13 +1558,53 @@ def _force_graph_locked(roots: Tuple[Deferred, ...]) -> None:
     out_idxs = tuple(sorted(emit))
     single = len(out_idxs) == 1
 
-    key = ("defer", root.comm.mesh, gshape, split, tuple(entry_sig), out_idxs)
-    plan = tuple(entries)
-    label = (
-        f"defer:{_op_label(plan[0][0])}..{_op_label(plan[-1][0])}[{len(plan)}]"
+    pl = _ForcePlan()
+    pl.root = root
+    pl.leaves = leaves
+    pl.leaf_donatable = leaf_donatable
+    pl.plan = tuple(entries)
+    pl.entry_sig = tuple(entry_sig)
+    pl.entry_nodes = entry_nodes
+    pl.arefs = arefs
+    pl.out_idxs = out_idxs
+    pl.root_idxs = root_idxs
+    pl.single = single
+    pl.gshape = gshape
+    pl.split = split
+    pl.padded = padded
+    pl.key = ("defer", root.comm.mesh, gshape, split, pl.entry_sig, out_idxs)
+    pl.label = (
+        f"defer:{_op_label(pl.plan[0][0])}..{_op_label(pl.plan[-1][0])}[{len(pl.plan)}]"
     )
     sharding = root.comm.sharding(root.ndim, split)
-    out_shardings = sharding if single else (sharding,) * len(out_idxs)
+    pl.out_shardings = sharding if single else (sharding,) * len(out_idxs)
+
+    # force-shape telemetry is a property of the PLAN, tallied here so both
+    # executors (and a queued dispatch that later falls back) count it once
+    n_interior = len(out_idxs) - len(set(root_idxs))
+    _stats.interior_outputs += n_interior
+    _stats.reexec_avoided += memo_hits
+    _stats.cse_hits += cse_hits
+    if diagnostics._enabled:
+        if n_interior:
+            diagnostics.counter("executor.interior_outputs", n_interior)
+        if memo_hits:
+            diagnostics.counter("executor.reexec_avoided", memo_hits)
+        if cse_hits:
+            diagnostics.counter("executor.cse_collapses", cse_hits)
+    return pl
+
+
+def _plan_builder(pl: _ForcePlan):
+    """The ``build`` callback :func:`lookup` compiles a plan's program from.
+    Closes over the plan TUPLE (not the _ForcePlan): the cached program must
+    pin the operations (id-key safety) but not the nodes."""
+    plan = pl.plan
+    out_idxs = pl.out_idxs
+    padded = pl.padded
+    gshape, split = pl.gshape, pl.split
+    single = pl.single
+    out_shardings = pl.out_shardings
 
     def build():
         def body(*leaf_vals):
@@ -1141,122 +1624,397 @@ def _force_graph_locked(roots: Tuple[Deferred, ...]) -> None:
 
         return body, out_shardings, None, None
 
-    prog = lookup(key, build, label=label)
-    n_interior = len(out_idxs) - len(set(root_idxs))
+    return build
 
-    def replay_eager():
-        # op-by-op replay of the plan: same per-node op order, one re-mask per
-        # emitted value (interior pad garbage never touches logical slots),
-        # layout pinned by comm.shard exactly like the eager dispatch path.
-        # Used below the warm-up jit threshold AND as the no-data-loss fallback
-        # when a compiled program's compile/execute fails — the `leaves` list
-        # holds every input reference until the program call succeeds, so the
-        # replay always has live buffers to read. Interior values are memoised
-        # identically to the compiled path.
-        vals = []
-        for operation, fn_kwargs, refs in plan:
-            args = [leaves[r[1]] if r[0] == "L" else vals[r[1]] for r in refs]
-            vals.append(operation(*args, **fn_kwargs))
-        results = []
-        for i in out_idxs:
-            result = vals[i]
-            if padded:
-                result = _zero_pads(result, gshape, split)
-            results.append(root.comm.shard(result, split))
-        return results
 
+def _plan_replay_eager(pl: _ForcePlan) -> list:
+    """Op-by-op replay of the plan: same per-node op order, one re-mask per
+    emitted value (interior pad garbage never touches logical slots), layout
+    pinned by comm.shard exactly like the eager dispatch path. Used below the
+    warm-up jit threshold AND as the no-data-loss fallback when a compiled
+    program's compile/execute fails — the plan's ``leaves`` list holds every
+    input reference until the program call succeeds, so the replay always has
+    live buffers to read. Interior values are memoised identically to the
+    compiled path."""
+    leaves = pl.leaves
+    vals = []
+    for operation, fn_kwargs, refs in pl.plan:
+        args = [leaves[r[1]] if r[0] == "L" else vals[r[1]] for r in refs]
+        vals.append(operation(*args, **fn_kwargs))
+    results = []
+    for i in pl.out_idxs:
+        result = vals[i]
+        if pl.padded:
+            result = _zero_pads(result, pl.gshape, pl.split)
+        results.append(pl.root.comm.shard(result, pl.split))
+    return results
+
+
+def _pick_donations(pl: _ForcePlan, prog: _Program) -> Tuple[int, ...]:
+    """Leaf positions safe (and useful) to donate: donatable per the plan,
+    aliasable onto an output slot of the same aval, refcount-proven sole-read
+    (``sanitation.sanitize_leaf_donation``), and not wasted on a full
+    donate-variant table."""
+    if not any(pl.leaf_donatable):
+        return ()
+    from . import sanitation
+
+    leaves = pl.leaves
+    arefs = pl.arefs
+    entry_nodes = pl.entry_nodes
+    # a donated buffer is only usable when XLA can alias it onto an output of
+    # the same aval, one donation per output slot — donating more just burns a
+    # jit variant and warns "donated buffers were not usable"
+    out_avals: Dict[Any, int] = {}
+    for i in pl.out_idxs:
+        aval = (tuple(entry_nodes[i][0].shape), np.dtype(entry_nodes[i][0].dtype))
+        out_avals[aval] = out_avals.get(aval, 0) + 1
+    picked = []
+    for i in range(len(leaves)):
+        # persistent refs when the plan is this leaf's last reader: its
+        # ("a", leaf) operand tuples + the leaves list. The call shape passes
+        # the subscript temp directly — no loop variable or enumerate tuple
+        # may hold an extra reference here.
+        if not pl.leaf_donatable[i]:
+            continue
+        aval = (tuple(leaves[i].shape), np.dtype(leaves[i].dtype))
+        if out_avals.get(aval, 0) > 0 and sanitation.sanitize_leaf_donation(
+            leaves[i], arefs.get(id(leaves[i]), 0) + 1
+        ):
+            out_avals[aval] -= 1
+            picked.append(i)
+    donate_idx = tuple(picked)
+    variants = prog._variants
+    if (
+        donate_idx
+        and variants is not None
+        and donate_idx not in variants
+        and len(variants) >= _MAX_DONATE_VARIANTS
+    ):
+        # the program's donate-variant table is full and this mask has no
+        # compiled variant: the call would run undonated, so decide that here
+        # — the donated_bytes tally must reflect reality
+        donate_idx = ()
+    return donate_idx
+
+
+def _memoise(pl: _ForcePlan, outs) -> None:
+    for value, i in zip(outs, pl.out_idxs):
+        for node in pl.entry_nodes[i]:
+            node.value = value
+    for nodes in pl.entry_nodes:
+        for node in nodes:
+            node.executed = True
+
+
+def _tally_donated(pl: _ForcePlan, donate_idx: Tuple[int, ...]) -> None:
+    """Account a SUCCESSFUL donating call's aliased bytes (stats + diagnostics
+    counter + profiler counter track) — one definition for both executors, so
+    async-vs-serialized telemetry can never skew."""
+    donated = sum(pl.leaves[i].nbytes for i in donate_idx)
+    _stats.donated_bytes += donated
+    if diagnostics._enabled:
+        diagnostics.counter("executor.donated_leaf_bytes", donated)
+    if profiler._active:
+        # counter track: cumulative donated bytes over the run
+        profiler.record_counter("donated_bytes", _stats.total("donated_bytes"))
+
+
+def _record_force_memory(pl: _ForcePlan, outs) -> None:
+    # force-boundary memory gauge: logical bytes this force touched (leaf
+    # inputs + emitted outputs) — the framework's live working set at the
+    # boundary, not an XLA allocator readout
+    live = sum(v.nbytes for v in pl.leaves if isinstance(v, jax.Array))
+    live += sum(getattr(o, "nbytes", 0) for o in outs)
+    profiler.record_force_memory(live)
+
+
+def _force_sync_locked(roots: Tuple[Deferred, ...]) -> None:
+    """The serialized executor: plan, call, and memoise under the lock —
+    today's ``HEAT_TPU_ASYNC_DISPATCH=0`` contract, bit for bit."""
+    pl = _linearise(roots)
+    if pl is None:
+        return
+    prog = lookup(pl.key, _plan_builder(pl), label=pl.label)
     if prog is None:
-        outs = replay_eager()
+        outs = _plan_replay_eager(pl)
     else:
-        donate_idx: Tuple[int, ...] = ()
-        if any(leaf_donatable):
-            from . import sanitation
-
-            # a donated buffer is only usable when XLA can alias it onto an
-            # output of the same aval, one donation per output slot — donating
-            # more just burns a jit variant and warns "donated buffers were
-            # not usable"
-            out_avals: Dict[Any, int] = {}
-            for i in out_idxs:
-                aval = (tuple(entry_nodes[i][0].shape), np.dtype(entry_nodes[i][0].dtype))
-                out_avals[aval] = out_avals.get(aval, 0) + 1
-            picked = []
-            for i in range(len(leaves)):
-                # persistent refs when the plan is this leaf's last reader:
-                # its ("a", leaf) operand tuples + the leaves list. The call
-                # shape passes the subscript temp directly — no loop variable
-                # or enumerate tuple may hold an extra reference here.
-                if not leaf_donatable[i]:
-                    continue
-                aval = (tuple(leaves[i].shape), np.dtype(leaves[i].dtype))
-                if out_avals.get(aval, 0) > 0 and sanitation.sanitize_leaf_donation(
-                    leaves[i], arefs.get(id(leaves[i]), 0) + 1
-                ):
-                    out_avals[aval] -= 1
-                    picked.append(i)
-            donate_idx = tuple(picked)
-            variants = prog._variants
-            if (
-                donate_idx
-                and variants is not None
-                and donate_idx not in variants
-                and len(variants) >= _MAX_DONATE_VARIANTS
-            ):
-                # the program's donate-variant table is full and this mask has
-                # no compiled variant: the call would run undonated, so decide
-                # that here — the donated_bytes tally must reflect reality
-                donate_idx = ()
+        donate_idx = _pick_donations(pl, prog)
         try:
             if donate_idx:
                 # donation-bearing calls never ride a retry policy: a retry
                 # after a post-dispatch failure would re-read buffers XLA may
                 # already have invalidated — the fallback below decides instead
-                outs = prog(*leaves, donate_leaves=donate_idx)
+                outs = prog(*pl.leaves, donate_leaves=donate_idx)
             elif resilience._active:
-                outs = resilience.guard("executor.execute", prog, *leaves, inject=False)
+                outs = resilience.guard(
+                    "executor.execute", prog, *pl.leaves, inject=False
+                )
             else:
-                outs = prog(*leaves)
-            if single:
+                outs = prog(*pl.leaves)
+            if pl.single:
                 outs = (outs,)
             if donate_idx:
                 # tallied only after the call succeeded: a failed (or injected)
                 # donated dispatch never actually aliased the buffers
-                donated = sum(leaves[i].nbytes for i in donate_idx)
-                _stats.donated_bytes += donated
-                if diagnostics._enabled:
-                    diagnostics.counter("executor.donated_leaf_bytes", donated)
-                if profiler._active:
-                    # counter track: cumulative donated bytes over the run
-                    profiler.record_counter("donated_bytes", _stats.donated_bytes)
+                _tally_donated(pl, donate_idx)
         except Exception as exc:
             if not fallback_after_failure(
-                key, prog, exc, donated=[leaves[i] for i in donate_idx]
+                pl.key, prog, exc, donated=[pl.leaves[i] for i in donate_idx]
             ):
                 raise
-            outs = replay_eager()
+            outs = _plan_replay_eager(pl)
     if profiler._active:
-        # force-boundary memory gauge: logical bytes this force touched (leaf
-        # inputs + emitted outputs) — the framework's live working set at the
-        # boundary, not an XLA allocator readout
-        live = sum(v.nbytes for v in leaves if isinstance(v, jax.Array))
-        live += sum(getattr(o, "nbytes", 0) for o in outs)
-        profiler.record_force_memory(live)
-    _stats.interior_outputs += n_interior
-    _stats.reexec_avoided += memo_hits
-    _stats.cse_hits += cse_hits
-    if diagnostics._enabled:
-        if n_interior:
-            diagnostics.counter("executor.interior_outputs", n_interior)
-        if memo_hits:
-            diagnostics.counter("executor.reexec_avoided", memo_hits)
-        if cse_hits:
-            diagnostics.counter("executor.cse_collapses", cse_hits)
-    for value, i in zip(outs, out_idxs):
-        for node in entry_nodes[i]:
-            node.value = value
-    for nodes in entry_nodes:
-        for node in nodes:
-            node.executed = True
+        _record_force_memory(pl, outs)
+    _memoise(pl, outs)
+
+
+def _force_async(roots: Tuple[Deferred, ...]) -> None:
+    """The async executor: plan under the lock, dispatch outside it.
+
+    Under the lock: linearise, look up the program, pick donations, claim the
+    per-buffer ownership (:func:`_acquire_buffers` — the invariant the global
+    lock used to carry), and install a dispatch-done future into every node
+    the program will emit. Outside the lock: resolve leaves still pending
+    from earlier in-flight forces, then execute — inline when the dispatch
+    path is idle, else queued to the fair scheduler (where same-signature
+    items batch). Warm-up / unsupported signatures replay op-by-op under the
+    lock exactly like the serialized path: below-threshold forces never
+    queue."""
+    sched = _get_scheduler()
+    with _tlock:
+        pl = _linearise(roots)
+        if pl is None:
+            return
+        prog = lookup(pl.key, _plan_builder(pl), label=pl.label)
+        if prog is None:
+            # warm-up / unsupported / quarantined: the op-by-op replay is the
+            # execution. With all-concrete leaves run it here, still under the
+            # lock — identical to the serialized path. A leaf still pending
+            # from an earlier in-flight force must be resolved OUTSIDE the
+            # lock first (its executor may need the lock to finish), so that
+            # shape falls through to the unlocked replay below.
+            if not any(isinstance(v, PendingValue) for v in pl.leaves):
+                outs = _plan_replay_eager(pl)
+                if profiler._active:
+                    _record_force_memory(pl, outs)
+                _memoise(pl, outs)
+                return
+            donate_idx = ()
+        else:
+            donate_idx = _pick_donations(pl, prog)
+        donate_set = set(donate_idx)
+        read_leaves = [
+            v for i, v in enumerate(pl.leaves)
+            if isinstance(v, jax.Array) and i not in donate_set
+        ]
+        granted_leaves = _acquire_buffers(
+            read_leaves, [pl.leaves[i] for i in donate_idx]
+        )
+        granted_ids = {id(v) for v in granted_leaves}
+        granted_idx = tuple(i for i in donate_idx if id(pl.leaves[i]) in granted_ids)
+        pendings = []
+        for i in pl.out_idxs:
+            node0 = pl.entry_nodes[i][0]
+            p = PendingValue(node0.shape, node0.dtype)
+            pendings.append(p)
+            for node in pl.entry_nodes[i]:
+                node.value = p
+        req = profiler.current_request() if profiler._active else None
+
+    # ---- lock released: everything below runs concurrently with other plans
+    released = []
+
+    def release_once():
+        if not released:
+            released.append(True)
+            _release_buffers(read_leaves, granted_leaves)
+
+    def fail(exc: BaseException) -> None:
+        release_once()
+        # nothing memoises: the futures stay installed but FAILED, so every
+        # current waiter (including the submitting thread's force) re-raises
+        # the error, and readers/planners then clear or re-plan them — the
+        # serialized path's raise-on-read, retry-on-next-force semantics.
+        # (Un-installing here instead would let the submitter re-read None
+        # and silently return nothing.)
+        for p in pendings:
+            p.fail(exc)
+
+    def complete(outs, donation_happened: bool = True) -> None:
+        release_once()
+        if granted_idx and donation_happened:
+            # tallied only when the DONATING call succeeded: a failed (or
+            # injected) dispatch that fell back to the eager replay never
+            # actually aliased the buffers
+            _tally_donated(pl, granted_idx)
+        _memoise(pl, outs)
+        for p, value in zip(pendings, outs):
+            p.fulfill(value)
+        if profiler._active:
+            _record_force_memory(pl, outs)
+
+    def execute() -> None:
+        # the whole single-item execution, fallback included; never raises —
+        # it runs on scheduler threads that must not die to user errors
+        donation_happened = True
+        try:
+            if prog is None:
+                # warm-up plan whose leaves were pending at lock time: the
+                # (now-resolved) op-by-op replay is the whole execution
+                complete(tuple(_plan_replay_eager(pl)), False)
+                return
+            try:
+                with profiler.attributed(req):
+                    if granted_idx:
+                        # donation-bearing calls never ride a retry policy: a
+                        # retry after a post-dispatch failure would re-read
+                        # buffers XLA may already have invalidated
+                        outs = prog(*pl.leaves, donate_leaves=granted_idx)
+                    elif resilience._active:
+                        outs = resilience.guard(
+                            "executor.execute", prog, *pl.leaves, inject=False
+                        )
+                    else:
+                        outs = prog(*pl.leaves)
+                if pl.single:
+                    outs = (outs,)
+            except Exception as exc:
+                # a fault (injected or real) inside a queued execution falls
+                # back to the op-by-op replay with no data loss: the plan's
+                # leaves list held every input buffer across the failed call
+                if not fallback_after_failure(
+                    pl.key, prog, exc,
+                    donated=[pl.leaves[i] for i in granted_idx],
+                ):
+                    fail(exc)
+                    return
+                outs = _plan_replay_eager(pl)
+                donation_happened = False
+            complete(tuple(outs), donation_happened)
+        except BaseException as exc:  # pragma: no cover - belt: waiters must
+            fail(exc)                 # never strand on a bookkeeping bug
+
+    try:
+        for i, v in enumerate(pl.leaves):
+            if isinstance(v, PendingValue):
+                # a leaf an earlier in-flight force will deliver: wait for its
+                # dispatch here, never under the lock (its executor may need
+                # the lock to finish)
+                pl.leaves[i] = v.resolve()
+    except BaseException as exc:
+        fail(exc)
+        raise
+
+    batch_key = None
+    if prog is not None and not granted_idx and batch_max() > 1:
+        scalar_fp: list = []
+        eligible = True
+        for j, v in enumerate(pl.leaves):
+            if isinstance(v, jax.Array):
+                continue
+            if isinstance(v, (int, float, bool, np.number, np.bool_)):
+                # scalar identity (type + repr) is part of the batch key: two
+                # forces only share a batched program when every non-array
+                # operand is literally the same value
+                scalar_fp.append((j, type(v).__name__, repr(v)))
+            else:
+                eligible = False
+                break
+        if eligible:
+            batch_key = (id(prog), tuple(scalar_fp))
+
+    if sched.try_inline():
+        # nobody else is dispatching: no handoff, no wake-up latency — the
+        # single-threaded cost of the async executor is this one try-acquire
+        try:
+            execute()
+        finally:
+            sched.end_inline()
+        return
+    tenant = None
+    if profiler._active:
+        tenant = profiler.current_request_tag()
+    if tenant is None:
+        tenant = f"t{threading.get_ident()}"
+    item = _scheduler.WorkItem(
+        tenant, execute, req=req, batch_key=batch_key, prog=prog,
+        leaves=pl.leaves, complete=complete, fail=fail,
+    )
+    if not _submit_with_backpressure(sched, item):
+        # the queue stayed full through the backpressure policy: run inline —
+        # slower than queued+batched, but work is never dropped
+        execute()
+
+
+def _execute_batch(items) -> None:
+    """Run 2+ same-signature queued forces as ONE batched program call
+    (:meth:`_Program.call_batched`). Installed as the scheduler's
+    ``batch_runner``; must never raise. On failure every item re-runs through
+    its own single path, which carries the replay_eager fallback — a broken
+    batch variant degrades to N singles, never to lost requests."""
+    width = len(items)
+    prog = items[0].prog
+    base = items[0].leaves
+    array_pos = tuple(j for j, v in enumerate(base) if isinstance(v, jax.Array))
+    scalar_pos = tuple(j for j in range(len(base)) if j not in array_pos)
+    try:
+        flat = [it.leaves[j] for it in items for j in array_pos]
+        scalars = [base[j] for j in scalar_pos]
+        with profiler.attributed(items[0].req):
+            out_flat = prog.call_batched(width, array_pos, scalar_pos, flat, scalars)
+        n_outs = len(out_flat) // width
+        if diagnostics._enabled:
+            diagnostics.counter("executor.batched_requests", width)
+        for i, it in enumerate(items):
+            it.complete(tuple(out_flat[i * n_outs: (i + 1) * n_outs]))
+    except BaseException as exc:
+        if diagnostics._enabled:
+            diagnostics.record_fallback(
+                "executor.batch",
+                f"{prog.label or 'program'}[x{width}]: {type(exc).__name__}: "
+                f"{exc} — re-running {width} forces singly",
+            )
+        for it in items:
+            it.execute()
+
+
+class _QueueFull(Exception):
+    pass
+
+
+# Backpressure for a full dispatch queue: retried under this policy (override
+# per deployment with resilience.set_policy("executor.queue", ...)), and on
+# exhaustion the submitter executes inline — bounded queue, unbounded work.
+_QUEUE_POLICY = resilience.Policy(
+    max_attempts=4, backoff_base=0.002, jitter=0.0, max_delay_s=0.05
+)
+
+
+def _submit_with_backpressure(sched, item) -> bool:
+    """Submit ``item``; a full queue retries under the ``executor.queue``
+    resilience policy. False means the caller should execute inline."""
+    bound = queue_bound()
+    if sched.submit(item, bound):
+        return True
+
+    def attempt():
+        if not sched.submit(item, bound):
+            raise _QueueFull(f"dispatch queue at bound {bound}")
+
+    policy = resilience.site_policy("executor.queue") or _QUEUE_POLICY
+    try:
+        policy.run("executor.queue", attempt)
+        return True
+    except _QueueFull:
+        if diagnostics._enabled:
+            diagnostics.record_fallback(
+                "executor.queue",
+                f"queue full (bound {bound}) after backpressure; executing inline",
+            )
+        return False
+
+
 
 
 # The executor's section of ht.diagnostics.report(): global counters plus the

@@ -1,0 +1,364 @@
+"""Async dispatch scheduler: a fair bounded work queue for executor forces.
+
+The lock-serialised executor (PRs 2-4) runs every deferred-graph force under
+one global ``RLock`` and blocks the caller until the program call returns —
+exactly the shape a multi-tenant serving deployment cannot have.  This module
+is the request-scheduler half of the async executor (``HEAT_TPU_ASYNC_DISPATCH``,
+default on): :mod:`_executor` plans a force under its lock (linearisation, CSE,
+donation decisions, pending-value installation) and hands the *execution* — the
+actual jitted program call, which needs no executor state — to this scheduler
+as a :class:`WorkItem`.
+
+Three properties the serving harness's open-loop p99 depends on:
+
+- **Inline fast path.** A submitter that finds the queue empty and nobody
+  executing runs its item on its own thread (no handoff, no wake-up latency) —
+  single-threaded workloads pay nothing for the queue's existence, and the
+  dispatch ops/s baseline gates keep enforcing that.
+- **Fair bounded queue.** Under contention items park in per-tenant FIFO
+  deques (tenant = the profiler's ambient request *tag*, falling back to the
+  submitting thread id) drained round-robin by one daemon scheduler thread, so
+  one chatty tenant cannot starve the rest.  The queue is bounded
+  (``HEAT_TPU_DISPATCH_QUEUE``); a full queue is backpressure, resolved by the
+  submitter through an ``ht.resilience`` policy (see
+  ``_executor._submit_with_backpressure``).
+- **Cross-request signature batching.** When the popped item is batchable
+  (same program signature, identical scalar operands, no donation) the
+  scheduler collects every matching item across *all* tenant queues — N
+  concurrent requests that resolved to the same cached program become ONE
+  batched execution through a ``jax.vmap``-derived variant of that program
+  (``_Program.call_batched``), amortising the per-dispatch floor the
+  8-rotating-batch serving workloads exist to exercise.  Batch widths are
+  bucketed to powers of two (capped by ``HEAT_TPU_BATCH_MAX``) so the set of
+  compiled batch variants stays bounded.
+
+:class:`PendingValue` is the dispatch-done future the executor installs into
+``Deferred.value`` while an item is queued/in flight: ``resolve()`` blocks only
+until the program *dispatch* returns (jax arrays are themselves asynchronous —
+device execution continues in the background), so a ``.parray`` read overlaps
+host-side graph building of other requests with device work.
+
+Telemetry (surfaced through ``ht.executor_stats()`` and mirrored as
+``ht.diagnostics`` counters by the executor): ``queue_depth_peak``,
+``batched_requests`` (requests that rode a batched execution),
+``batch_width_hist`` (batch width -> count), plus submit/inline tallies.  When
+the profiler is active every enqueue/dequeue records a ``queue_depth`` counter
+sample, exported as a Perfetto counter track.
+
+Stdlib-only at module load (the executor imports it lazily-cheap); all jax
+work lives in the closures the executor puts on the items.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["PendingValue", "WorkItem", "DispatchScheduler"]
+
+
+class PendingValue:
+    """A dispatch-done future standing in for a forced node's concrete value.
+
+    Installed into ``Deferred.value`` when the executor hands a planned force
+    to the scheduler; carries the node's physical aval so graph building can
+    keep using the node (shape/dtype reads, operand signatures) without
+    waiting.  :meth:`resolve` blocks until the program call *dispatched* (not
+    until the device finished — the fulfilled value is an async ``jax.Array``)
+    and either returns the value or re-raises the execution's failure.
+    """
+
+    __slots__ = ("shape", "dtype", "_event", "_value", "_error")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+
+    def fulfill(self, value) -> None:
+        if self._event.is_set():
+            return  # first outcome wins: a late belt-path fail/fulfill is a no-op
+        self._value = value
+        self._event.set()
+
+    def fail(self, error: BaseException) -> None:
+        if self._event.is_set():
+            return
+        self._error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def failed(self) -> bool:
+        """True once the dispatch completed WITH an error. The executor treats
+        a failed pending as "unforced": readers re-raise (and clear it so the
+        next force retries), planners re-plan the subchain — the serialized
+        path's every-read-retries failure semantics."""
+        return self._event.is_set() and self._error is not None
+
+    def resolve(self):
+        self._event.wait()
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class WorkItem:
+    """One planned force execution.
+
+    ``execute`` runs the single-item path end to end (program call, failure
+    fallback, buffer release, memoisation, future fulfilment) and NEVER raises
+    — the executor builds it that way so a scheduler thread cannot die to a
+    user-level failure.  ``batch_key`` is ``None`` for items that must run
+    alone (donation granted, warm-up, scalar-free ineligibility); batchable
+    items additionally expose the structured fields ``prog`` / ``leaves`` /
+    ``complete`` / ``fail`` that ``_executor._execute_batch`` consumes.
+    """
+
+    __slots__ = (
+        "seq", "tenant", "req", "execute", "batch_key", "prog", "leaves",
+        "complete", "fail",
+    )
+
+    def __init__(self, tenant: str, execute: Callable[[], None], *,
+                 req=None, batch_key=None, prog=None, leaves=None,
+                 complete=None, fail=None):
+        self.seq = 0  # assigned by the scheduler at submit
+        self.tenant = tenant
+        self.req = req
+        self.execute = execute
+        self.batch_key = batch_key
+        self.prog = prog
+        self.leaves = leaves
+        self.complete = complete
+        self.fail = fail
+
+
+def _bucket_width(n: int, cap: int) -> int:
+    """Largest power of two <= min(n, cap): batch widths are bucketed so each
+    program compiles at most log2(cap) batched variants."""
+    n = min(n, max(1, cap))
+    w = 1
+    while w * 2 <= n:
+        w *= 2
+    return w
+
+
+class DispatchScheduler:
+    """The fair bounded dispatch queue plus its daemon drain thread.
+
+    ``batch_runner(items)`` is injected by the executor (avoids an import
+    cycle): called with 2+ same-``batch_key`` items, it must fulfil every
+    item's futures itself and never raise.
+    """
+
+    def __init__(self, batch_runner: Optional[Callable[[List[WorkItem]], None]] = None):
+        self._cv = threading.Condition()
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        # batch_key -> queued batchable items (insertion order): batch
+        # collection is an O(width) index lookup, not an O(depth) scan of
+        # every tenant deque under the lock
+        self._by_key: Dict[object, List[WorkItem]] = {}
+        self._depth = 0
+        self._active = 0          # executions in flight (inline + thread)
+        self._paused = False      # test hook: hold items in the queue
+        self._seq = itertools.count(1)
+        self._thread: Optional[threading.Thread] = None
+        self.batch_runner = batch_runner
+        # telemetry (mutated under _cv; read via stats())
+        self.queue_depth_peak = 0
+        self.batched_requests = 0
+        self.batch_width_hist: Dict[int, int] = {}
+        self.submitted = 0
+        self.inline_runs = 0
+        self.queue_full_events = 0
+
+    # ------------------------------------------------------------- submission
+    def try_inline(self) -> bool:
+        """Claim the inline fast path: True when the queue is empty and nothing
+        is executing — the submitter runs its item on its own thread (call
+        :meth:`end_inline` when done).  Under contention returns False and the
+        item should be queued instead."""
+        with self._cv:
+            if self._depth == 0 and self._active == 0 and not self._paused:
+                self._active += 1
+                self.inline_runs += 1
+                return True
+            return False
+
+    def end_inline(self) -> None:
+        with self._cv:
+            self._active -= 1
+            self._cv.notify_all()
+
+    def submit(self, item: WorkItem, bound: int) -> bool:
+        """Park ``item`` in its tenant's queue. False when the queue is at
+        ``bound`` — the caller applies its backpressure policy and retries or
+        executes inline."""
+        with self._cv:
+            if self._depth >= bound:
+                self.queue_full_events += 1
+                return False
+            item.seq = next(self._seq)
+            q = self._queues.get(item.tenant)
+            if q is None:
+                q = self._queues[item.tenant] = deque()
+            q.append(item)
+            if item.batch_key is not None:
+                self._by_key.setdefault(item.batch_key, []).append(item)
+            self._depth += 1
+            self.submitted += 1
+            if self._depth > self.queue_depth_peak:
+                self.queue_depth_peak = self._depth
+            depth = self._depth
+            self._ensure_thread()
+            self._cv.notify_all()
+        self._note_depth(depth)
+        return True
+
+    def depth(self) -> int:
+        with self._cv:
+            return self._depth
+
+    # ------------------------------------------------------------- drain loop
+    def _ensure_thread(self) -> None:
+        # called under _cv
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="heat-tpu-dispatch", daemon=True
+            )
+            self._thread.start()
+
+    def _unindex_locked(self, item: WorkItem) -> None:
+        if item.batch_key is None:
+            return
+        peers = self._by_key.get(item.batch_key)
+        if peers is not None:
+            try:
+                peers.remove(item)
+            except ValueError:
+                pass
+            if not peers:
+                del self._by_key[item.batch_key]
+
+    def _pop_group_locked(self, batch_cap: int) -> List[WorkItem]:
+        """Round-robin tenant pop + cross-tenant batch collection. Under _cv."""
+        item: Optional[WorkItem] = None
+        for tenant in list(self._queues):
+            q = self._queues[tenant]
+            if q:
+                item = q.popleft()
+                self._queues.move_to_end(tenant)  # fairness: rotate the tenant
+                if not q:
+                    del self._queues[tenant]
+                break
+        if item is None:
+            return []
+        self._unindex_locked(item)
+        group = [item]
+        if item.batch_key is not None and batch_cap > 1:
+            # gather same-signature items from EVERY tenant queue (this is the
+            # cross-request half of signature batching) via the batch-key
+            # index, oldest first — no full-queue scan under the lock
+            matches = list(self._by_key.get(item.batch_key, ()))
+            matches.sort(key=lambda w: w.seq)
+            width = _bucket_width(1 + len(matches), batch_cap)
+            take = matches[: width - 1]
+            for w in take:
+                self._queues[w.tenant].remove(w)
+                self._unindex_locked(w)
+                if not self._queues[w.tenant]:
+                    del self._queues[w.tenant]
+            group.extend(take)
+        self._depth -= len(group)
+        return group
+
+    def _loop(self) -> None:
+        from . import _executor  # late: the executor imports this module first
+
+        while True:
+            with self._cv:
+                while self._depth == 0 or self._paused:
+                    self._cv.wait()
+                group = self._pop_group_locked(_executor.batch_max())
+                if not group:
+                    continue
+                self._active += 1
+                if len(group) > 1:
+                    width = len(group)
+                    self.batched_requests += width
+                    self.batch_width_hist[width] = (
+                        self.batch_width_hist.get(width, 0) + 1
+                    )
+                depth = self._depth
+            self._note_depth(depth)
+            try:
+                if len(group) == 1:
+                    group[0].execute()
+                else:
+                    self.batch_runner(group)
+            except BaseException as exc:  # item contracts say "never raise" —
+                # this is the last-ditch guard so a bug cannot strand waiters
+                for w in group:
+                    try:
+                        if w.fail is not None:
+                            w.fail(exc)
+                    except BaseException:
+                        pass
+            finally:
+                with self._cv:
+                    self._active -= 1
+                    self._cv.notify_all()
+
+    # ------------------------------------------------------------- telemetry
+    def _note_depth(self, depth: int) -> None:
+        from . import profiler
+
+        if profiler._active:
+            profiler.record_counter("queue_depth", depth)
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "queue_depth": self._depth,
+                "queue_depth_peak": self.queue_depth_peak,
+                "batched_requests": self.batched_requests,
+                "batch_width_hist": dict(self.batch_width_hist),
+                "submitted": self.submitted,
+                "inline_runs": self.inline_runs,
+                "queue_full_events": self.queue_full_events,
+            }
+
+    def reset_stats(self) -> None:
+        with self._cv:
+            self.queue_depth_peak = self._depth
+            self.batched_requests = 0
+            self.batch_width_hist = {}
+            self.submitted = 0
+            self.inline_runs = 0
+            self.queue_full_events = 0
+
+    # -------------------------------------------------------------- test hooks
+    def pause(self) -> None:
+        """Hold queued items (tests build deterministic batches this way).
+        Inline fast-path claims are refused while paused, so every submission
+        parks in the queue."""
+        with self._cv:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until the queue is empty and nothing is executing."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: self._depth == 0 and self._active == 0, timeout=timeout
+            )

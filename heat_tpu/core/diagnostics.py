@@ -74,11 +74,14 @@ than locked:
 - the ``HEAT_TPU_DIAG_LOG`` file append in :func:`record_backend_event` runs
   OUTSIDE the lock (a slow disk must not stall telemetry); interleaved lines
   from two processes are whole-line atomic on POSIX appends of this size;
-- the executor's ``_stats`` tallies (in :mod:`_executor`) are incremented
-  un-locked on a few hot paths (``retraces`` inside a traced body, the
-  memo-hit ``reexec_avoided`` fast path) — they may UNDERCOUNT under racing
-  threads, never corrupt; the signature table itself and every decision made
-  from it are fully lock-protected.
+- the executor's ``_stats`` tallies (in :mod:`_executor`) are PER-THREAD
+  accumulator cells merged at report time: increments stay lock-free on the
+  hot paths (``retraces`` inside a traced body, the memo-hit
+  ``reexec_avoided`` fast path, the scheduler thread's execution tallies)
+  yet counts are EXACT — the async dispatch scheduler made the old
+  relaxed-racing-``+=`` undercount a real risk instead of a curiosity. The
+  signature table itself and every decision made from it are fully
+  lock-protected.
 """
 
 from __future__ import annotations

@@ -93,6 +93,8 @@ __all__ = [
     "reset",
     "request",
     "current_request",
+    "current_request_tag",
+    "attributed",
     "scope",
     "observe",
     "histogram_snapshots",
@@ -316,6 +318,36 @@ def current_request() -> Optional[int]:
     """The ambient request id (inside a :func:`request` scope on this
     thread/context), or None."""
     return _current_request.get()
+
+
+def current_request_tag() -> Optional[str]:
+    """The ambient request's *tag* (the string passed to :func:`request`), or
+    None outside a request scope / while disabled. The async executor uses
+    this as the tenant key for its fair dispatch queue: requests sharing a tag
+    share one round-robin slot."""
+    rid = _current_request.get()
+    if rid is None:
+        return None
+    with _lock:
+        entry = _requests.get(rid)
+        return entry["tag"] if entry is not None else None
+
+
+@contextlib.contextmanager
+def attributed(req: Optional[int]):
+    """Make ``req`` the ambient request for this thread for the duration of
+    the block (no-op for ``None`` or while disabled). The dispatch scheduler
+    wraps queued executions in this so program-call and collective slices
+    running on the scheduler thread still attribute to the request that
+    planned the force."""
+    if req is None or not _active:
+        yield
+        return
+    token = _current_request.set(req)
+    try:
+        yield
+    finally:
+        _current_request.reset(token)
 
 
 @contextlib.contextmanager

@@ -33,6 +33,14 @@ write fail on demand. This module centralises all of it:
   policy-driven retry, the primitive behind the checkpoint manifest writer and
   the whole-file ``ht.save`` paths.
 
+The async executor's bounded dispatch queue (ISSUE 8) resolves queue-full
+backpressure through this module: a refused submit retries under the
+``executor.queue`` site policy (register an override with
+``set_policy("executor.queue", Policy(...))`` to tune a deployment's
+backpressure; the executor's built-in default is a few-millisecond ladder)
+and, exhausted, executes inline — retries and exhaustions land in the
+resilience event stream like every other site's.
+
 Zero-cost contract (same discipline as ``ht.diagnostics`` and
 ``HEAT_TPU_TRACE``): instrumented sites gate on the module attributes
 ``resilience._armed`` (a fault plan is loaded) / ``resilience._active``

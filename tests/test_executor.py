@@ -17,12 +17,21 @@ Five groups, mirroring the executor's contract (``heat_tpu/core/_executor.py``):
   executes exactly once across its consumers (memoised interior outputs),
   structural CSE collapses separately-built identical subexpressions, leaf
   donation follows the sanitize_leaf_donation refcount contract, and the
-  warm-up eager replay memoises interior values identically.
+  warm-up eager replay memoises interior values identically;
+- async multi-tenant executor (ISSUE 8): the concurrency hammer (shared and
+  disjoint graphs across threads, eager bit-parity), serialized-mode
+  (``HEAT_TPU_ASYNC_DISPATCH=0``) bit-parity, deterministic cross-request
+  signature batching through the paused scheduler, donation-epoch refusal
+  cases against the per-buffer ownership registry, queue-full backpressure
+  (bounded queue, inline fallback, nothing dropped), and the exactness of the
+  per-thread telemetry cells.
 """
 
 import contextlib
 import gc
 import os
+import threading
+import time
 import weakref
 
 import numpy as np
@@ -820,3 +829,398 @@ class TestMultiOutputFusedGraphs(TestCase):
         self.assertEqual(ht.executor_stats()["retraces"], retraces)
         np.testing.assert_array_equal(mid.numpy(), np_a * 0.5)
         np.testing.assert_array_equal(tip.numpy(), np_a * 0.5 + 1.0)
+
+
+@contextlib.contextmanager
+def _env(name, value):
+    old = os.environ.get(name)
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = value
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = old
+
+
+class TestAsyncExecutor(TestCase):
+    """ISSUE 8 tentpole: non-blocking forces through the dispatch scheduler,
+    cross-request signature batching, the fair bounded queue's backpressure,
+    and donation-epoch (per-buffer ownership) safety."""
+
+    def setUp(self):
+        super().setUp()
+        sched = _executor._dispatch_scheduler
+        if sched is not None:
+            sched.resume()
+            self.assertTrue(sched.wait_idle(30.0), "scheduler stuck busy")
+        _executor.clear_executor_cache()
+
+    tearDown_resume = True
+
+    def tearDown(self):
+        sched = _executor._dispatch_scheduler
+        if sched is not None:
+            sched.resume()
+            sched.wait_idle(30.0)
+        super().tearDown()
+
+    def _queue_forces(self, thunks, min_depth):
+        """Pause the scheduler, run each thunk on its own thread (every force
+        parks in the queue — the paused scheduler also refuses the inline
+        fast path), wait until the queue holds ``min_depth`` items, resume,
+        and join. Returns the per-thunk results."""
+        sched = _executor._get_scheduler()
+        results = [None] * len(thunks)
+        errors = []
+
+        def runner(i, fn):
+            try:
+                results[i] = fn()
+            except Exception as exc:  # surfaced after join
+                errors.append(exc)
+
+        sched.pause()
+        try:
+            threads = [
+                threading.Thread(target=runner, args=(i, fn), daemon=True)
+                for i, fn in enumerate(thunks)
+            ]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 30.0
+            while sched.depth() < min_depth and time.monotonic() < deadline:
+                time.sleep(0.005)
+            self.assertGreaterEqual(sched.depth(), min_depth, "forces never queued")
+        finally:
+            sched.resume()
+        for t in threads:
+            t.join(timeout=60.0)
+        self.assertFalse(errors, errors)
+        return results
+
+    def test_async_vs_serialized_bit_parity(self):
+        np_a, np_b = _np_pair(_RAGGED)
+
+        def chain():
+            a, b = ht.array(np_a, split=0), ht.array(np_b, split=0)
+            t = a + b
+            u = t * 2.0
+            v = t * 3.0
+            return u.numpy(), v.numpy(), t.numpy()
+
+        async_res = chain()  # default: async dispatch
+        with _env("HEAT_TPU_ASYNC_DISPATCH", "0"):
+            sync_res = chain()
+        with eager_dispatch():
+            eager_res = chain()
+        for name, a_, s_, e_ in zip("uvt", async_res, sync_res, eager_res):
+            self.assertEqual(a_.tobytes(), s_.tobytes(),
+                             f"{name}: async != serialized bits")
+            self.assertEqual(a_.tobytes(), e_.tobytes(),
+                             f"{name}: async != eager bits")
+
+    def test_concurrency_hammer_shared_and_disjoint(self):
+        # disjoint graphs per thread (same signature: batch fodder) plus one
+        # SHARED diamond every thread races to force. The reference bits are
+        # the executor's own single-threaded (inline, unbatched) results, so
+        # this asserts batched/queued execution is BIT-identical to single
+        # dispatch — numpy is not a valid last-bit oracle here (XLA may
+        # contract mul+add into an fma).
+        np_a, np_b = _np_pair(_EVEN)
+        datas = [
+            np.random.default_rng(100 + i).standard_normal(_EVEN).astype(np.float32)
+            for i in range(8)
+        ]
+        arrs = [ht.array(d, split=0) for d in datas]
+        expected = [((arrs[i] * 1.5) + 0.25).numpy() for i in range(8)]
+        for i in range(8):  # loose sanity vs numpy (fma-tolerant)
+            np.testing.assert_allclose(
+                expected[i], datas[i] * 1.5 + 0.25, rtol=1e-6, atol=1e-6
+            )
+        a, b = ht.array(np_a, split=0), ht.array(np_b, split=0)
+        t = a + b
+        u = t * 2.0
+        v = t * 3.0
+        shared = {"u": ((a + b) * 2.0).numpy(), "v": ((a + b) * 3.0).numpy()}
+        errors = []
+
+        def worker(i):
+            try:
+                for _ in range(10):
+                    got = ((arrs[i] * 1.5) + 0.25).numpy()
+                    self.assertEqual(got.tobytes(), expected[i].tobytes(),
+                                     f"thread {i}: concurrent != single bits")
+                key = "u" if i % 2 else "v"
+                got = (u if i % 2 else v).numpy()
+                self.assertEqual(got.tobytes(), shared[key].tobytes(),
+                                 f"thread {i}: shared {key} bits diverged")
+            except Exception as exc:
+                errors.append(exc)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120.0)
+        self.assertFalse(errors, errors)
+        self.assertEqual(ht.executor_stats()["reexecuted"], 0)
+
+    def test_cross_request_batching_deterministic(self):
+        datas = [np.full(_EVEN, float(i + 1), np.float32) for i in range(4)]
+        arrs = [ht.array(d, split=0) for d in datas]
+        for arr in arrs:
+            (arr * 2.0 + 1.0).parray  # warm the signature: batches replay
+        ht.reset_executor_stats()
+        results = self._queue_forces(
+            [lambda i=i: (arrs[i] * 2.0 + 1.0).numpy() for i in range(4)],
+            min_depth=4,
+        )
+        for i, got in enumerate(results):
+            np.testing.assert_array_equal(got, datas[i] * 2.0 + 1.0)
+        stats = ht.executor_stats()
+        self.assertGreaterEqual(stats["batched_requests"], 4)
+        self.assertIn(4, stats["batch_width_hist"])
+        self.assertGreaterEqual(stats["queue_depth_peak"], 4)
+
+    def test_distinct_scalars_never_share_a_batch(self):
+        np_a, _ = _np_pair(_EVEN)
+        x = ht.array(np_a, split=0)
+        (x * 5.0).parray
+        (x * 7.0).parray
+        results = self._queue_forces(
+            [lambda: (x * 5.0).numpy(), lambda: (x * 7.0).numpy()],
+            min_depth=2,
+        )
+        np.testing.assert_array_equal(results[0], np_a * np.float32(5.0))
+        np.testing.assert_array_equal(results[1], np_a * np.float32(7.0))
+
+    def test_queue_full_backpressure_executes_inline(self):
+        # bound 1 + paused scheduler: the first force parks, the rest exhaust
+        # the executor.queue backpressure policy and run INLINE — every value
+        # still arrives, and the full-queue events are counted
+        np_a, _ = _np_pair(_EVEN)
+        x = ht.array(np_a, split=0)
+        (x + 0.5).parray  # warm
+        ht.reset_executor_stats()
+        with _env("HEAT_TPU_DISPATCH_QUEUE", "1"):
+            results = self._queue_forces(
+                [lambda k=k: ((x + 0.5) * float(k + 1)).numpy() for k in range(3)],
+                min_depth=1,
+            )
+        for k, got in enumerate(results):
+            np.testing.assert_array_equal(
+                got, (np_a + np.float32(0.5)) * np.float32(k + 1)
+            )
+        self.assertGreaterEqual(ht.executor_stats()["queue_full_events"], 1)
+
+    def test_donation_epoch_refusal_inflight_reader(self):
+        # the per-buffer ownership registry: a leaf with a registered
+        # in-flight reader passes the refcount check (sole Python holder) but
+        # MUST be refused donation — and the buffer must survive the force
+        np_a, _ = _np_pair(_EVEN)
+        x = ht.array(np_a, split=0)
+        y = x * 2.0
+        buf_id = id(x._payload)
+        ref = weakref.ref(x._payload)
+        del x
+        ht.reset_executor_stats()
+        with _executor._own_lock:
+            _executor._inflight_reads[buf_id] = 1
+        try:
+            got = y.numpy()
+        finally:
+            with _executor._own_lock:
+                _executor._inflight_reads.pop(buf_id, None)
+        np.testing.assert_array_equal(got, np_a * 2.0)
+        stats = ht.executor_stats()
+        self.assertEqual(stats["donated_bytes"], 0)
+        self.assertGreaterEqual(stats["donation_refusals"], 1)
+        held = ref()
+        if held is not None:
+            self.assertFalse(held.is_deleted(), "refused donation still deleted")
+
+    def test_donation_epoch_refusal_standing_claim(self):
+        np_a, _ = _np_pair(_EVEN)
+        x = ht.array(np_a, split=0)
+        y = x * 3.0
+        buf_id = id(x._payload)
+        del x
+        ht.reset_executor_stats()
+        with _executor._own_lock:
+            _executor._donation_claims[buf_id] = 999
+        try:
+            got = y.numpy()
+        finally:
+            with _executor._own_lock:
+                _executor._donation_claims.pop(buf_id, None)
+        np.testing.assert_array_equal(got, np_a * 3.0)
+        self.assertEqual(ht.executor_stats()["donated_bytes"], 0)
+        self.assertGreaterEqual(ht.executor_stats()["donation_refusals"], 1)
+
+    def test_donation_still_granted_when_unowned(self):
+        # async path sanity: with no competing owner the donation goes through
+        # exactly as the serialized executor's (ISSUE 5 contract)
+        np_a, _ = _np_pair(_EVEN)
+        x = ht.array(np_a, split=0)
+        y = x * 2.0
+        del x
+        ht.reset_executor_stats()
+        y.parray
+        self.assertGreater(ht.executor_stats()["donated_bytes"], 0)
+        with _executor._own_lock:
+            self.assertEqual(_executor._donation_claims, {},
+                             "claims must be released after the call")
+            self.assertEqual(_executor._inflight_reads, {},
+                             "reads must be released after the call")
+
+    def test_acquire_release_buffer_registry(self):
+        a = jnp.arange(8.0)
+        b = jnp.arange(8.0) + 1.0
+        reads = [a]
+        granted = _executor._acquire_buffers(reads, [b])
+        self.assertEqual([id(v) for v in granted], [id(b)])
+        # a buffer with an in-flight reader is refused and demoted to a read
+        reads2 = []
+        granted2 = _executor._acquire_buffers(reads2, [a])
+        self.assertEqual(granted2, [])
+        self.assertEqual(reads2, [a])
+        _executor._release_buffers(reads2, granted2)
+        _executor._release_buffers(reads, granted)
+        with _executor._own_lock:
+            self.assertEqual(_executor._inflight_reads, {})
+            self.assertEqual(_executor._donation_claims, {})
+
+    def test_stats_fields_present_and_lock_wait_counted(self):
+        stats = ht.executor_stats()
+        for key in (
+            "queue_depth_peak", "batched_requests", "batch_width_hist",
+            "lock_wait_ns", "donation_refusals", "queue_full_events",
+            "inline_dispatches", "queued_dispatches",
+        ):
+            self.assertIn(key, stats)
+        # a thread blocked on the executor lock charges lock_wait_ns
+        ht.reset_executor_stats()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with _executor._lock:
+                entered.set()
+                release.wait(10.0)
+
+        def waiter():
+            with _executor._tlock:
+                pass
+
+        th = threading.Thread(target=holder)
+        tw = threading.Thread(target=waiter)
+        th.start()
+        self.assertTrue(entered.wait(10.0))
+        tw.start()
+        time.sleep(0.05)
+        release.set()
+        th.join(10.0)
+        tw.join(10.0)
+        self.assertGreater(ht.executor_stats()["lock_wait_ns"], 0)
+
+    def test_per_thread_tallies_are_exact_under_contention(self):
+        # the old relaxed racing `+=` could undercount; the per-thread cells
+        # merged at report time must count EVERY lookup exactly
+        np_a, _ = _np_pair(_EVEN)
+        arrs = [ht.array(np_a * (i + 1), split=0) for i in range(4)]
+        for arr in arrs:
+            (arr * 1.25).parray  # compile each thread's signature... same sig,
+        # one program: later forces are pure hits
+        ht.reset_executor_stats()
+        per_thread = 25
+
+        def worker(i):
+            for _ in range(per_thread):
+                (arrs[i] * 1.25).parray
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(60.0)
+        stats = ht.executor_stats()
+        self.assertEqual(stats["hits"], 4 * per_thread)
+        self.assertEqual(stats["misses"], 0)
+
+    def test_serialized_mode_keeps_scheduler_idle(self):
+        np_a, np_b = _np_pair(_EVEN)
+        with _env("HEAT_TPU_ASYNC_DISPATCH", "0"):
+            ht.reset_executor_stats()
+            a, b = ht.array(np_a, split=0), ht.array(np_b, split=0)
+            (a + b).parray
+            stats = ht.executor_stats()
+        self.assertEqual(stats["inline_dispatches"], 0)
+        self.assertEqual(stats["queued_dispatches"], 0)
+
+
+class TestAsyncFailureDelivery(TestCase):
+    """Review hardening (ISSUE 8): terminal dispatch failures must RAISE at
+    the reader — never silently return None — and clear themselves so the
+    next force retries; warm-up replays must resolve pending leaves."""
+
+    def test_terminal_dispatch_failure_raises_then_retries_clean(self):
+        import unittest.mock as mock
+
+        from heat_tpu.core import resilience
+
+        _executor.clear_executor_cache()
+        np_a, _ = _np_pair(_EVEN)
+        warm = ht.array(np_a, split=0)
+        (warm * 2.5).parray  # compile the signature: the fault hits execute
+        x = ht.array(np_a, split=0)
+        y = x * 2.5
+        resilience.arm_fault_plan(
+            [{"site": "executor.execute", "on_call": 1, "count": 999,
+              "kind": "raise"}]
+        )
+        try:
+            # the replay fallback is ALSO broken: the failure is terminal and
+            # must surface as an exception (pre-fix: silent None payload)
+            with mock.patch.object(
+                _executor, "_plan_replay_eager",
+                side_effect=RuntimeError("replay dead"),
+            ):
+                with self.assertRaises(Exception):
+                    y.parray
+        finally:
+            resilience.disarm_fault_plan()
+        # the failed future cleared itself: the same node now forces cleanly
+        np.testing.assert_array_equal(y.numpy(), np_a * np.float32(2.5))
+
+    def test_warmup_replay_resolves_pending_leaf(self):
+        from heat_tpu.core._scheduler import PendingValue
+
+        with contextlib.ExitStack() as stack:
+            old = os.environ.get("HEAT_TPU_JIT_THRESHOLD")
+            os.environ["HEAT_TPU_JIT_THRESHOLD"] = "5"
+            stack.callback(
+                lambda: os.environ.update({"HEAT_TPU_JIT_THRESHOLD": old})
+                if old is not None
+                else os.environ.pop("HEAT_TPU_JIT_THRESHOLD", None)
+            )
+            _executor.clear_executor_cache()
+            np_a, _ = _np_pair(_EVEN)
+            x = ht.array(np_a, split=0)
+            y = x * 2.0
+            z = y + 1.0
+            node = y._payload
+            self.assertIsInstance(node, _executor.Deferred)
+            # simulate an in-flight async force of y: its dispatch-done
+            # future is installed but z's warm-up force must still replay
+            concrete = ht.array(np_a * 2.0, split=0).parray
+            p = PendingValue(node.shape, node.dtype)
+            p.fulfill(concrete)
+            node.value = p
+            np.testing.assert_allclose(
+                z.numpy(), np_a * 2.0 + 1.0, rtol=1e-6, atol=1e-6
+            )

@@ -400,6 +400,119 @@ class TestChaosExecutor(_ResilienceCase):
         x = ht.array(np_a, split=0)
         np.testing.assert_array_equal(((x + 1.0) * 3.0).numpy(), (np_a + 1.0) * 3.0)
 
+# ----------------------------------------------------- chaos: async executor
+class TestChaosAsyncExecutor(_ResilienceCase):
+    """ISSUE 8: faults firing inside QUEUED executions (single and batched)
+    must fall back via the op-by-op replay with no data loss — the scheduler
+    thread is not the caller, so the failure contract has to travel through
+    the dispatch-done future and the plan's held leaf references."""
+
+    def _sched(self):
+        import threading
+        import time
+
+        sched = _executor._get_scheduler()
+        sched.resume()
+        self.assertTrue(sched.wait_idle(30.0))
+        return sched, threading, time
+
+    def tearDown(self):
+        sched = _executor._dispatch_scheduler
+        if sched is not None:
+            sched.resume()
+            sched.wait_idle(30.0)
+        super().tearDown()
+
+    def test_fault_inside_queued_execution_replays_eager_no_data_loss(self):
+        sched, threading, time = self._sched()
+        _executor.clear_executor_cache()
+        np_a = np.linspace(-2.0, 2.0, 16, dtype=np.float32)
+        x = ht.array(np_a, split=0)
+        expected = ((x + 1.0) * 2.0 - 0.5).numpy()  # warm + reference bits
+        diagnostics.enable()
+        resilience.arm_fault_plan(
+            [{"site": "executor.execute", "on_call": 1, "count": 99,
+              "kind": "raise"}]
+        )
+        got = {}
+        errors = []
+
+        def force():
+            try:
+                got["v"] = ((x + 1.0) * 2.0 - 0.5).numpy()
+            except Exception as exc:
+                errors.append(exc)
+
+        sched.pause()  # the force must park in the queue, not run inline
+        try:
+            th = threading.Thread(target=force, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 30.0
+            while sched.depth() < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            self.assertGreaterEqual(sched.depth(), 1, "force never queued")
+        finally:
+            sched.resume()
+        th.join(60.0)
+        self.assertFalse(errors, errors)
+        np.testing.assert_array_equal(got["v"], expected)
+        stats = ht.executor_stats()
+        self.assertGreaterEqual(stats["eager_fallbacks"], 1)
+        self.assertEqual(stats.get("quarantined", {}), {})
+
+    def test_fault_inside_batched_execution_no_data_loss(self):
+        sched, threading, time = self._sched()
+        _executor.clear_executor_cache()
+        datas = [
+            np.linspace(-1.0, 1.0, 16, dtype=np.float32) * (i + 1)
+            for i in range(2)
+        ]
+        arrs = [ht.array(d, split=0) for d in datas]
+        expected = [((a * 2.0) + 1.0).numpy() for a in arrs]  # warm, unbatched
+        diagnostics.enable()
+        got = [None, None]
+        errors = []
+
+        def force(i):
+            try:
+                got[i] = ((arrs[i] * 2.0) + 1.0).numpy()
+            except Exception as exc:
+                errors.append(exc)
+
+        sched.pause()
+        try:
+            threads = [
+                threading.Thread(target=force, args=(i,), daemon=True)
+                for i in range(2)
+            ]
+            for th in threads:
+                th.start()
+            deadline = time.monotonic() + 30.0
+            while sched.depth() < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            self.assertGreaterEqual(sched.depth(), 2, "forces never queued")
+            # armed only now: the faults fire inside the BATCHED execution
+            resilience.arm_fault_plan(
+                [{"site": "executor.execute", "on_call": 1, "count": 99,
+                  "kind": "raise"}]
+            )
+        finally:
+            sched.resume()
+        for th in threads:
+            th.join(60.0)
+        self.assertFalse(errors, errors)
+        for i in range(2):
+            np.testing.assert_array_equal(got[i], expected[i])
+        stats = ht.executor_stats()
+        # the batch degraded to singles, each single to the eager replay
+        self.assertGreaterEqual(stats["eager_fallbacks"], 2)
+        self.assertTrue(
+            any(c.startswith("fallback.executor.") for c in self._counters()),
+            self._counters(),
+        )
+
+
+
 
 # ------------------------------------------------------------------ chaos: checkpoint
 class TestChaosCheckpoint(_ResilienceCase):

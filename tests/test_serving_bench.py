@@ -146,3 +146,108 @@ class TestServingHarness(TestCase):
         self.assertAlmostEqual(harness._percentile_ms(lats, 0.50), 51.0)
         self.assertAlmostEqual(harness._percentile_ms(lats, 0.99), 99.0)
         self.assertAlmostEqual(harness._percentile_ms(lats, 1.0), 100.0)
+
+
+class TestMixedScenario(TestCase):
+    """ISSUE 8 satellite: all four request types through ONE shared pool."""
+
+    def tearDown(self):
+        profiler.disable()
+        profiler.reset()
+        super().tearDown()
+
+    def test_mixed_records_and_per_workload_breakdown(self):
+        records, failed = harness.run(
+            smoke=True, requests=8, concurrency=2, which=["mixed"],
+            emit=lambda line: None,
+        )
+        self.assertFalse(failed)
+        self.assertEqual([r["workload"] for r in records], ["mixed", "mixed"])
+        closed, open_ = records
+        self.assertEqual(closed["metric"], "serving_mixed_closed_rps")
+        # the interleave rotates deterministically over all four types
+        self.assertEqual(set(closed["per_workload"]), set(BUILDERS))
+        self.assertEqual(
+            sum(v["requests"] for v in closed["per_workload"].values()),
+            closed["requests"],
+        )
+        # the aggregate histogram is the exact merge of the per-type ones
+        self.assertEqual(closed["latency_hist"]["count"], closed["requests"])
+        self.assertIn("offered_rps", open_)
+
+    def test_open_rps_pinning(self):
+        records, _ = harness.run(
+            smoke=True, requests=6, concurrency=2, which=["sparse_matvec"],
+            open_rps={"sparse_matvec": 123.0}, emit=lambda line: None,
+        )
+        open_ = [r for r in records if r["mode"] == "open"][0]
+        self.assertEqual(open_["offered_rps"], 123.0)
+
+    def test_mixed_baseline_covers_ci_matrix(self):
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(harness.__file__)),
+            "serving_baseline.json",
+        )
+        with open(path) as f:
+            baseline = json.load(f)
+        for devices in ("3", "8"):
+            envelope = baseline[devices].get("mixed")
+            self.assertIsNotNone(envelope,
+                                 f"no mixed envelope at {devices} devices")
+            self.assertGreater(envelope["min_rps"], 0)
+        self.assertIn("_async_gate", baseline)
+        recorded = baseline["_async_gate"]["recorded"]
+        self.assertLessEqual(recorded["open_p99_geomean_ratio"], 1.0,
+                             "the recorded async win must actually be a win")
+
+
+class TestAsyncGateEvaluation(TestCase):
+    """The async-executor serving gate's record math (pure, no load run)."""
+
+    @staticmethod
+    def _arm(name, closed_p50, open_p99, offered=100.0):
+        return [
+            {"workload": name, "mode": "closed", "value": 100.0,
+             "p50_ms": closed_p50, "p99_ms": closed_p50 * 2},
+            {"workload": name, "mode": "open", "value": 80.0,
+             "p50_ms": closed_p50, "p99_ms": open_p99,
+             "offered_rps": offered},
+        ]
+
+    def test_async_win_passes(self):
+        from benchmarks.serving import async_gate
+
+        ser = self._arm("wl", 10.0, 40.0)
+        asy = self._arm("wl", 10.0, 30.0)
+        comps, failed = async_gate.evaluate(ser, asy, emit=lambda s: None)
+        self.assertFalse(failed)
+        summary = [c for c in comps if c["metric"] == "serving_async_gate_summary"]
+        self.assertEqual(len(summary), 1)
+        self.assertLess(summary[0]["open_p99_geomean_ratio"], 1.0)
+
+    def test_p99_regression_fails(self):
+        from benchmarks.serving import async_gate
+
+        ser = self._arm("wl", 10.0, 40.0)
+        asy = self._arm("wl", 10.0, 44.0)  # 1.1x: worse overall
+        _, failed = async_gate.evaluate(ser, asy, emit=lambda s: None)
+        self.assertTrue(failed)
+
+    def test_closed_p50_regression_fails(self):
+        from benchmarks.serving import async_gate
+
+        ser = self._arm("wl", 10.0, 40.0)
+        asy = self._arm("wl", 10.0 * 1.5, 30.0)  # p99 wins but p50 blew up
+        _, failed = async_gate.evaluate(ser, asy, emit=lambda s: None)
+        self.assertTrue(failed)
+
+    def test_missing_arm_warns_and_fails_empty(self):
+        from benchmarks.serving import async_gate
+
+        out = []
+        _, failed = async_gate.evaluate(
+            self._arm("wl", 10.0, 40.0), [],
+            emit=lambda s: out.append(json.loads(s)),
+        )
+        self.assertTrue(failed)
+        self.assertTrue(any("warning" in r or "error" in r for r in out))
