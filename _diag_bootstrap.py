@@ -71,7 +71,7 @@ def load_resilience():
         spec = importlib.util.spec_from_file_location("_heat_tpu_resilience", path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-    except Exception:
+    except Exception:  # ht: ignore[silent-except] -- best-effort standalone load: callers treat None as resilience-unavailable and degrade
         return None
     # visible to a LATER package import, whose module-level adoption hook then
     # shares this instance's breaker registry (one relay-health state per process)
@@ -106,7 +106,7 @@ def load_diagnostics():
         spec = importlib.util.spec_from_file_location("_heat_tpu_diagnostics", path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-    except Exception:
+    except Exception:  # ht: ignore[silent-except] -- best-effort standalone load: callers treat None as health-recording-unavailable and degrade
         return None
     _DIAG = mod
     return mod

@@ -327,6 +327,53 @@ def _bench_dispatch(devices: int = 8, timeout_s: float = 900.0) -> list:
     return records
 
 
+def _bench_analysis(timeout_s: float = 600.0) -> dict:
+    """Invariant-checker findings count (``python -m heat_tpu.analysis``) as a
+    trajectory gauge: 0 means the tree is analysis-clean (new findings, stale
+    baseline entries, and pragma misuse all count). Pure host-side static
+    analysis in a subprocess, so it joins the round even relay-down."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as td:
+        report_path = os.path.join(td, "analysis-report.json")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-m", "heat_tpu.analysis", "--check",
+             "--baseline", os.path.join(here, "analysis_baseline.json"),
+             "--json", report_path],
+            capture_output=True, text=True, timeout=timeout_s, cwd=here, env=env,
+        )
+        if not os.path.exists(report_path):
+            # the checker crashed before emitting its report: record THAT as a
+            # dirty datapoint (with the real cause) rather than dropping the
+            # gauge — a broken checker must not look like a skipped benchmark
+            return {
+                "metric": "analysis_findings",
+                "value": None,
+                "unit": "findings",
+                "clean": False,
+                "error": f"checker produced no report (rc={proc.returncode}): "
+                         f"{proc.stderr[-500:]}",
+            }
+        with open(report_path) as f:
+            report = json.load(f)
+    findings = len(report.get("new_findings", [])) + len(report.get("stale_baseline", []))
+    return {
+        "metric": "analysis_findings",
+        "value": findings,
+        "unit": "findings",
+        "clean": proc.returncode == 0,
+        "modules_scanned": report.get("modules_scanned"),
+        "grandfathered": len(report.get("grandfathered", [])),
+        "lock_order_cycles": len(report.get("lock_graph", {}).get("cycles", [])),
+    }
+
+
 def _bench_serving(devices: int = 8, timeout_s: float = 900.0) -> list:
     """Host-side serving latency smoke (``benchmarks/serving/harness.py``) in a
     hermetic virtual CPU mesh subprocess: closed+open-loop throughput with
@@ -561,6 +608,10 @@ def main():
         traceback.print_exc(file=sys.stderr)
     try:
         dispatch_extras += _bench_serving()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    try:
+        dispatch_extras.append(_bench_analysis())
     except Exception:
         traceback.print_exc(file=sys.stderr)
 

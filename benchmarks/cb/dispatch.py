@@ -207,10 +207,12 @@ def _run_cases(ht, jax, jnp, _executor, ndev, base_cases, check, baseline_tol, e
         t_exec = _time_case(ht, jax, fn, x, y)
         stats = _executor.executor_stats()
         os.environ["HEAT_TPU_EAGER_DISPATCH"] = "1"
+        _executor.reload_env_knobs()  # the knob is memoised: re-read for the eager arm
         try:
             t_eager = _time_case(ht, jax, fn, x, y)
         finally:
             del os.environ["HEAT_TPU_EAGER_DISPATCH"]
+            _executor.reload_env_knobs()
         rec = {
             "metric": f"dispatch_chain{n_ops}_{name}_ops_s",
             "value": round(n_ops / t_exec, 1),

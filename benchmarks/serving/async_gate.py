@@ -130,12 +130,13 @@ def compare(smoke=True, requests=32, concurrency=4, open_fraction=0.85,
     defaults HIGHER than the plain harness (0.85 vs 0.6): the serialized
     executor must be pushed into its queueing regime for the comparison to
     measure what the scheduler fixes."""
-    from heat_tpu.core import profiler
+    from heat_tpu.core import _executor, profiler
 
     old = os.environ.get("HEAT_TPU_ASYNC_DISPATCH")
     try:
         profiler.reset()  # fresh histograms per comparison (retries included)
         os.environ["HEAT_TPU_ASYNC_DISPATCH"] = "0"
+        _executor.reload_env_knobs()  # the knob is memoised off the per-force hot path
         emit(json.dumps({"info": "async gate arm 1/2: serialized executor"}))
         records_ser, _ = run(
             smoke=smoke, requests=requests, concurrency=concurrency,
@@ -148,6 +149,7 @@ def compare(smoke=True, requests=32, concurrency=4, open_fraction=0.85,
         }
         profiler.reset()  # arm 1's histograms must not fold into arm 2's
         os.environ["HEAT_TPU_ASYNC_DISPATCH"] = "1"
+        _executor.reload_env_knobs()
         emit(json.dumps({"info": "async gate arm 2/2: async executor",
                          "offered_rps": open_rps}))
         records_asy, _ = run(
@@ -159,6 +161,7 @@ def compare(smoke=True, requests=32, concurrency=4, open_fraction=0.85,
             os.environ.pop("HEAT_TPU_ASYNC_DISPATCH", None)
         else:
             os.environ["HEAT_TPU_ASYNC_DISPATCH"] = old
+        _executor.reload_env_knobs()
     return evaluate(records_ser, records_asy, emit=emit)
 
 

@@ -1,0 +1,29 @@
+"""``ht.analysis`` — the framework invariant checker.
+
+A stdlib-only, AST-driven static analysis over the whole ``heat_tpu`` package
+that turns the prose invariants the codebase already states — the padded
+layout's "pads always hold zero" contract, HLO byte-parity when telemetry is
+idle, the stdlib-only-at-load bootstrap contract, the locked-vs-relaxed
+thread-safety policy in ``diagnostics.py``, and the donation contracts in
+``sanitation.py`` — into blocking, mechanically-enforced rules. See
+``doc/source/static_analysis.rst`` for the rule catalogue and the origin of
+each invariant.
+
+Run it as a separate process (nothing in ``heat_tpu/__init__.py`` imports this
+package, so the checker can never add runtime cost)::
+
+    python -m heat_tpu.analysis [--baseline analysis_baseline.json]
+                                [--explain RULE] [--check]
+                                [--dump-lockgraph PATH] [--json PATH]
+
+Suppressions are per-line pragmas with a mandatory reason, written
+``# ht: ignore[<rule-id>] -- why this is safe`` on the offending line (angle
+brackets stand for the actual rule id). An unused pragma is itself an error,
+and so is a baseline entry that no longer matches a finding — the suppression
+surface can only shrink.
+"""
+
+from .engine import Finding, run_analysis  # noqa: F401  (stdlib-only)
+from .rules import RULES, explain  # noqa: F401
+
+__all__ = ["Finding", "run_analysis", "RULES", "explain"]

@@ -1,0 +1,119 @@
+"""CLI for the invariant checker: ``python -m heat_tpu.analysis``.
+
+Exit status is the contract CI blocks on: 0 when every finding is either
+fixed, pragma-suppressed (with a reason), or baselined — and the baseline has
+no stale entries — else 1. ``--check`` is an explicit alias for the default
+blocking mode (kept so the CI invocation reads as a gate); ``--write-baseline``
+regenerates the grandfathered set; ``--dump-lockgraph`` exports the discovered
+lock-acquisition graph (.json or .dot by extension) for
+``doc/source/_static/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import baseline as baseline_mod
+from . import rules, rules_locks
+from .engine import run_analysis
+
+REPORT_SCHEMA = "heat-tpu-analysis/1"
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m heat_tpu.analysis",
+        description="heat_tpu framework invariant checker (static analysis)",
+    )
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON (default: <repo>/analysis_baseline.json "
+                             "when present)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from the current findings and exit 0")
+    parser.add_argument("--check", action="store_true",
+                        help="blocking mode (the default behaviour; kept explicit for CI)")
+    parser.add_argument("--explain", metavar="RULE",
+                        help="print one rule's invariant and origin, then exit")
+    parser.add_argument("--json", metavar="PATH",
+                        help="write the findings report as JSON to PATH")
+    parser.add_argument("--dump-lockgraph", metavar="PATH",
+                        help="write the lock-acquisition graph (.dot or .json) and exit")
+    parser.add_argument("--root", default=None,
+                        help="package root to scan (default: the installed heat_tpu)")
+    args = parser.parse_args(argv)
+
+    if args.explain:
+        print(rules.explain(args.explain))
+        return 0 if args.explain in rules.RULES else 1
+
+    findings, uni = run_analysis(package_root=args.root)
+
+    if args.dump_lockgraph:
+        payload = rules_locks.lock_graph_payload(uni)
+        if args.dump_lockgraph.endswith(".dot"):
+            with open(args.dump_lockgraph, "w", encoding="utf-8") as fh:
+                fh.write(rules_locks.lock_graph_dot(payload))
+        else:
+            with open(args.dump_lockgraph, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, indent=2, sort_keys=True)
+                fh.write("\n")
+        print(f"lock graph: {len(payload['nodes'])} locks, "
+              f"{len(payload['edges'])} edges, "
+              f"{len(payload['cycles'])} cycle(s) -> {args.dump_lockgraph}")
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        default = os.path.join(_repo_root(), "analysis_baseline.json")
+        baseline_path = default if os.path.exists(default) else None
+
+    if args.write_baseline:
+        target = baseline_path or os.path.join(_repo_root(), "analysis_baseline.json")
+        baseline_mod.save(target, findings)
+        print(f"baseline written: {len(findings)} grandfathered finding(s) -> {target}")
+        return 0
+
+    entries = baseline_mod.load(baseline_path) if baseline_path else []
+    new, grandfathered, stale = baseline_mod.apply(findings, entries)
+
+    blocking = new + stale
+    for f in blocking:
+        print(f.render())
+    if grandfathered:
+        print(f"({len(grandfathered)} grandfathered finding(s) suppressed by "
+              f"{baseline_path})")
+
+    if args.json:
+        report = {
+            "schema": REPORT_SCHEMA,
+            "modules_scanned": len(uni.modules),
+            "new_findings": [f.as_dict() for f in new],
+            "stale_baseline": [f.as_dict() for f in stale],
+            "grandfathered": [f.as_dict() for f in grandfathered],
+            "lock_graph": rules_locks.lock_graph_payload(uni),
+        }
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if blocking:
+        print(f"FAIL: {len(new)} new finding(s), {len(stale)} stale baseline "
+              "entr(y/ies). Fix them, pragma with a reason "
+              "('ht: ignore' + [rule] + '-- why'), or --write-baseline.")
+        return 1
+    print(f"OK: {len(uni.modules)} modules clean "
+          f"({len(grandfathered)} baselined).")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+"""Per-line pragma suppressions: ``# ht: ignore[<rule-id>] -- reason``.
+
+A pragma lives on the finding's own line (for multi-line statements: the line
+the checker reports, i.e. the AST node's ``lineno``). Several rules may be
+listed comma-separated. The ``-- reason`` is mandatory — a suppression without
+a recorded justification is itself a finding (``pragma-no-reason``), and a
+pragma that suppresses nothing is dead weight that would silently grandfather
+a future regression, so it is a finding too (``pragma-unused``). Unknown rule
+ids fail as ``pragma-unknown-rule`` rather than silently never matching.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+from .engine import Finding, ModuleIndex
+
+_PRAGMA_RE = re.compile(
+    r"#\s*ht:\s*ignore\[(?P<rules>[a-zA-Z0-9_,\s-]+)\]"
+    r"(?:\s*--\s*(?P<reason>\S.*))?"
+)
+
+
+class Pragma:
+    __slots__ = ("line", "rules", "reason", "used")
+
+    def __init__(self, line: int, rules: List[str], reason: str):
+        self.line = line
+        self.rules = rules
+        self.reason = reason
+        self.used: set = set()  # rule ids that actually suppressed a finding
+
+
+def collect(mod: ModuleIndex) -> Dict[int, Pragma]:
+    table: Dict[int, Pragma] = {}
+    for i, text in enumerate(mod.lines, start=1):
+        m = _PRAGMA_RE.search(text)
+        if not m:
+            continue
+        rules = [r.strip() for r in m.group("rules").split(",") if r.strip()]
+        table[i] = Pragma(i, rules, (m.group("reason") or "").strip())
+    return table
+
+
+def suppressed(table: Dict[int, Pragma], finding: Finding) -> bool:
+    pragma = table.get(finding.line)
+    if pragma is None or finding.rule not in pragma.rules:
+        return False
+    if not pragma.reason:
+        return False  # a reasonless pragma suppresses nothing
+    pragma.used.add(finding.rule)
+    return True
+
+
+def misuse_findings(mod: ModuleIndex, table: Dict[int, Pragma]) -> List[Finding]:
+    from .rules import RULES
+
+    out: List[Finding] = []
+    for pragma in table.values():
+        snippet = mod.snippet(pragma.line)
+        if not pragma.reason:
+            out.append(Finding(
+                "pragma-no-reason", mod.rel_path, pragma.line,
+                "pragma has no '-- reason'; justifications are mandatory",
+                snippet,
+            ))
+            continue
+        for rule in pragma.rules:
+            if rule not in RULES:
+                out.append(Finding(
+                    "pragma-unknown-rule", mod.rel_path, pragma.line,
+                    f"pragma names unknown rule {rule!r}", snippet,
+                ))
+            elif rule not in pragma.used:
+                out.append(Finding(
+                    "pragma-unused", mod.rel_path, pragma.line,
+                    f"pragma for {rule!r} suppresses nothing — remove it",
+                    snippet,
+                ))
+    return out

@@ -1,0 +1,117 @@
+"""Rule registry: ids, one-paragraph explanations (``--explain``), runners."""
+
+from __future__ import annotations
+
+from . import rules_donation, rules_fallbacks, rules_imports, rules_locks, rules_purity
+
+RULES = {
+    "trace-env-read": (
+        "No os.environ/os.getenv reads inside traced bodies. A traced body "
+        "runs once per compile; an env value read there is frozen into the "
+        "executable and silently ignored on every replay — the HLO-byte-"
+        "parity contract (doc/source/observability.rst) and the env-knob "
+        "semantics both break. Hoist the read to the host-side dispatch "
+        "path (see _executor's memoised knob accessors)."
+    ),
+    "trace-time-call": (
+        "No time.* / random.* / np.random.* calls inside traced bodies: "
+        "trace-time wall-clock or host randomness bakes one value into the "
+        "cached program. Use jax.random with explicit keys for traced "
+        "randomness; host timing belongs around the dispatch, not in it."
+    ),
+    "trace-telemetry-unguarded": (
+        "diagnostics/profiler record calls inside traced bodies must be "
+        "gated on the subsystem switch (if diagnostics._enabled: ...). "
+        "Ungated, they run per TRACE (surprising counts) and break the "
+        "zero-cost-when-disabled contract every telemetry module documents."
+    ),
+    "trace-global-write": (
+        "No mutable-global writes inside traced bodies: the write happens at "
+        "trace time only, so replays never repeat it — state silently "
+        "diverges between the first call and every later one."
+    ),
+    "trace-lazy-import": (
+        "No import statements inside traced bodies: lazy package imports at "
+        "trace time run module init under jit and make the first trace "
+        "behave differently from a warm process."
+    ),
+    "lock-unlocked-write": (
+        "State classified locked-exact by its module's thread-safety policy "
+        "(the diagnostics.py docstring pattern, transcribed into "
+        "rules_locks.LOCK_POLICY) must only be written under `with <lock>`. "
+        "Functions named *_locked are called with the lock held (documented "
+        "convention); __init__ construction is exempt. Relaxed state is "
+        "listed per module and exempt by name."
+    ),
+    "lock-racing-increment": (
+        "`+=` on shared module-level state outside any lock is a racing "
+        "read-modify-write — the exact undercount bug the executor's _stats "
+        "per-thread cells (the sanctioned exemption) were built to kill. "
+        "Route increments through a per-thread cell or take the owning lock."
+    ),
+    "lock-order-cycle": (
+        "The cross-module lock-acquisition graph (edge A->B when code "
+        "holding A acquires B) must stay acyclic, or two threads can "
+        "deadlock. The committed graph lives at "
+        "doc/source/_static/lock_graph.json (regenerate with "
+        "--dump-lockgraph); scheduler-sharding work must keep it a DAG."
+    ),
+    "import-nonstdlib": (
+        "diagnostics/profiler/resilience/_scheduler/_diag_bootstrap (and "
+        "heat_tpu.analysis itself) import only the stdlib at module level, "
+        "so the driver entry points can load them by file path before "
+        "touching the JAX backend. Heavy imports belong inside functions. "
+        "tests/test_analysis.py proves the same contract dynamically."
+    ),
+    "silent-except": (
+        "except Exception without re-raise or a diagnostics.record_fallback/"
+        "record_resilience_event/fallback_after_failure call swallows "
+        "failures invisibly — the pre-PR-5 bug class. Narrow the handler to "
+        "the expected types, account the fallback, or pragma with a reason."
+    ),
+    "donation-uncontracted": (
+        "donate_argnums outside _executor.py bypasses the sanitation "
+        "refcount contracts (sanitize_donation / sanitize_leaf_donation) "
+        "that prove no live reader holds the buffer being invalidated."
+    ),
+    "collective-uncontracted": (
+        "Direct jax.lax collectives outside communication.py are invisible "
+        "to ht.diagnostics (the per-collective telemetry contract) and "
+        "ht.resilience/_guarded. Call the MeshCommunication method instead."
+    ),
+    "pragma-no-reason": (
+        "Every suppression pragma must carry `-- reason`: suppressions "
+        "without recorded justification are how grandfathered bugs hide."
+    ),
+    "pragma-unknown-rule": (
+        "The pragma names a rule id the checker does not know — it would "
+        "never match anything and gives false confidence."
+    ),
+    "pragma-unused": (
+        "The pragma suppresses nothing on its line. Dead pragmas silently "
+        "grandfather FUTURE violations; remove them as soon as the finding "
+        "they covered is fixed."
+    ),
+    "baseline-stale": (
+        "A baseline entry matched no current finding: the offending code was "
+        "fixed. Delete the entry (python -m heat_tpu.analysis "
+        "--write-baseline) so the grandfathered set only ever shrinks."
+    ),
+}
+
+RULE_RUNNERS = [
+    rules_purity.run,
+    rules_locks.run_discipline,
+    rules_locks.run_lock_order,
+    rules_imports.run,
+    rules_fallbacks.run,
+    rules_donation.run,
+]
+
+
+def explain(rule: str) -> str:
+    doc = RULES.get(rule)
+    if doc is None:
+        known = ", ".join(sorted(RULES))
+        return f"unknown rule {rule!r}; known rules: {known}"
+    return f"{rule}\n{'=' * len(rule)}\n{doc}"

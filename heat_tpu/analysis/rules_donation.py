@@ -1,0 +1,66 @@
+"""Donation and collective discipline.
+
+- ``donation-uncontracted``: ``donate_argnums`` / ``donate_argnames`` invalidate
+  buffers; the only call sites allowed to use them are in ``_executor.py``,
+  where every donation is gated by the refcount contracts in ``sanitation.py``
+  (``sanitize_donation`` / ``sanitize_leaf_donation``). A jit call elsewhere
+  that donates has no such proof and can invalidate a buffer a live DNDarray
+  still wraps.
+
+- ``collective-uncontracted``: ``jax.lax`` data-moving collectives are only
+  legal inside ``shard_map`` bodies, and the framework routes every one of
+  them through ``MeshCommunication`` so they are (a) recorded in
+  ``ht.diagnostics`` (op, axis, participants, bytes — the observability
+  contract) and (b) guarded by ``ht.resilience`` / ``ht.profiler`` via
+  ``_guarded``. A direct ``jax.lax.psum`` elsewhere is invisible to all three
+  subsystems; call the corresponding ``comm`` method instead. (Pure topology
+  reads — ``axis_index`` — and primitives with no comm wrapper are exempt.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import Finding, Universe, dotted_chain
+
+DONATION_HOME = "heat_tpu.core._executor"
+COLLECTIVE_HOME = "heat_tpu.core.communication"
+
+WRAPPED_COLLECTIVES = {
+    "psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "pshuffle", "psum_scatter",
+}
+
+
+def run(uni: Universe) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in uni.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if mod.name != DONATION_HOME:
+                for kw in node.keywords:
+                    if kw.arg in ("donate_argnums", "donate_argnames"):
+                        out.append(mod.finding(
+                            "donation-uncontracted", node,
+                            f"{kw.arg} outside _executor.py: donation must go "
+                            "through the sanitation refcount contracts "
+                            "(sanitize_donation / sanitize_leaf_donation)",
+                        ))
+            if mod.name != COLLECTIVE_HOME:
+                chain = dotted_chain(node.func)
+                if (
+                    chain
+                    and len(chain) >= 2
+                    and chain[-2] == "lax"
+                    and chain[-1] in WRAPPED_COLLECTIVES
+                ):
+                    out.append(mod.finding(
+                        "collective-uncontracted", node,
+                        f"direct jax.lax.{chain[-1]} outside communication.py: "
+                        f"route through MeshCommunication.{chain[-1]} so the "
+                        "collective is diagnostics-recorded and resilience-"
+                        "guarded",
+                    ))
+    return out

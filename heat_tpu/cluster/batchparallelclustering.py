@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import heat_tpu as ht
 from ..core.base import BaseEstimator, ClusteringMixin
 from ..core.dndarray import DNDarray
+from ..spatial.distance import _pairwise
 
 __all__ = ["BatchParallelKMeans", "BatchParallelKMedians"]
 
@@ -80,8 +81,6 @@ def _kmex_loop(X, centers0, p, n_clusters, max_iter, tol):
 
 
 def _cdist_p(x: jax.Array, y: jax.Array, p: int) -> jax.Array:
-    from ..spatial.distance import _pairwise
-
     return _pairwise(x, y, "manhattan" if p == 1 else "euclidean")
 
 

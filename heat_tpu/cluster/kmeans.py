@@ -80,11 +80,13 @@ class KMeans(_KCluster):
         def sharded(xv, centers):
             def body(xl, c):
                 labels, sums, counts, sse = fused_assign_update(xl, c)
+                # comm-routed (not raw jax.lax.psum): records the collective
+                # family in ht.diagnostics and rides the resilience guard
                 return (
                     labels,
-                    jax.lax.psum(sums, axis),
-                    jax.lax.psum(counts, axis),
-                    jax.lax.psum(sse, axis),
+                    comm.psum(sums, axis_name=axis),
+                    comm.psum(counts, axis_name=axis),
+                    comm.psum(sse, axis_name=axis),
                 )
 
             return jax.shard_map(

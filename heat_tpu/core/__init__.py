@@ -4,7 +4,12 @@ from . import diagnostics
 from . import profiler
 from . import resilience
 from .communication import *
-from ._executor import executor_stats, reset_executor_stats, clear_executor_cache
+from ._executor import (
+    executor_stats,
+    reset_executor_stats,
+    clear_executor_cache,
+    reload_env_knobs,
+)
 from .constants import *
 from .devices import *
 from .types import *

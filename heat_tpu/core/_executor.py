@@ -113,6 +113,7 @@ __all__ = [
     "executor_stats",
     "reset_executor_stats",
     "clear_executor_cache",
+    "reload_env_knobs",
     "executor_enabled",
     "async_dispatch_enabled",
 ]
@@ -266,6 +267,60 @@ _seen: Dict[Any, int] = {}
 _MAX_SEEN = 8192
 
 
+# ----------------------------------------------------------------- env knobs
+# The dispatch knobs used to be re-read from os.environ on every call —
+# async_dispatch_enabled() per force, executor_enabled() per op (twice for
+# binary ops), batch_max() per queued submit. Each read is cheap, but the hot
+# dispatch path paid them millions of times for values that change a handful
+# of times per process. They are now MEMOISED: parsed once at import, and
+# re-read only at the two documented re-read points —
+#
+#   * reload_env_knobs()      — the explicit API; call it after mutating
+#     os.environ in-process (tests, benchmarks, the serving async-gate);
+#   * clear_executor_cache()  — dropping every cached program is the natural
+#     moment to re-honour the environment that shapes new ones.
+#
+# A fresh process always re-reads at import, so subprocess-armed knobs need
+# nothing extra.
+
+
+class _EnvKnobs:
+    __slots__ = (
+        "eager_dispatch", "async_dispatch", "jit_threshold",
+        "queue_bound", "batch_max", "quarantine_after",
+    )
+
+    def reload(self) -> None:
+        def _int(name: str, default: int) -> int:
+            try:
+                return max(1, int(os.environ.get(name, str(default))))
+            except ValueError:
+                return default
+
+        self.eager_dispatch = os.environ.get("HEAT_TPU_EAGER_DISPATCH") == "1"
+        self.async_dispatch = os.environ.get("HEAT_TPU_ASYNC_DISPATCH", "1") != "0"
+        self.jit_threshold = _int("HEAT_TPU_JIT_THRESHOLD", 1)
+        self.queue_bound = _int("HEAT_TPU_DISPATCH_QUEUE", 256)
+        self.batch_max = _int("HEAT_TPU_BATCH_MAX", 8)
+        self.quarantine_after = _int("HEAT_TPU_QUARANTINE_AFTER", 3)
+
+
+_knobs = _EnvKnobs()
+_knobs.reload()
+
+
+def reload_env_knobs() -> None:
+    """Re-read every memoised ``HEAT_TPU_*`` dispatch knob from ``os.environ``.
+
+    The knobs (``HEAT_TPU_EAGER_DISPATCH`` / ``ASYNC_DISPATCH`` /
+    ``JIT_THRESHOLD`` / ``DISPATCH_QUEUE`` / ``BATCH_MAX`` /
+    ``QUARANTINE_AFTER``) are parsed once at import and memoised off the hot
+    dispatch path; in-process environment mutations take effect at the next
+    call to this function (or to :func:`clear_executor_cache`, which re-reads
+    as part of dropping the program table)."""
+    _knobs.reload()
+
+
 def jit_threshold() -> int:
     """How many sightings of a signature before the executor compiles it.
 
@@ -274,11 +329,9 @@ def jit_threshold() -> int:
     ``N-1`` sightings take the original eager path and only compile signatures
     that prove hot: the right trade for signature-diverse workloads (test
     suites, exploratory sessions) where most programs would compile once and
-    never replay. Read per call, so it can be flipped in-process."""
-    try:
-        return max(1, int(os.environ.get("HEAT_TPU_JIT_THRESHOLD", "1")))
-    except ValueError:
-        return 1
+    never replay. Memoised; see :func:`reload_env_knobs` for the re-read
+    contract."""
+    return _knobs.jit_threshold
 
 
 _single_controller: Optional[bool] = None
@@ -287,14 +340,15 @@ _single_controller: Optional[bool] = None
 def executor_enabled() -> bool:
     """Whether dispatch should route through the cached-program executor.
 
-    ``HEAT_TPU_EAGER_DISPATCH=1`` is the debugging escape hatch (read per call so
-    tests can flip it); multi-controller processes always take the eager path —
-    its ``comm.shard`` has the per-process shard-population logic the staged
-    programs do not replicate. The process count is resolved once (it cannot
-    change after backend initialisation, and dispatch calls this per op —
-    twice for binary ops — so the xla_bridge round-trip matters)."""
+    ``HEAT_TPU_EAGER_DISPATCH=1`` is the debugging escape hatch (memoised —
+    call :func:`reload_env_knobs` after flipping it in-process);
+    multi-controller processes always take the eager path — its ``comm.shard``
+    has the per-process shard-population logic the staged programs do not
+    replicate. The process count is resolved once (it cannot change after
+    backend initialisation, and dispatch calls this per op — twice for binary
+    ops — so the xla_bridge round-trip matters)."""
     global _single_controller
-    if os.environ.get("HEAT_TPU_EAGER_DISPATCH") == "1":
+    if _knobs.eager_dispatch:
         return False
     if _single_controller is None:
         _single_controller = jax.process_count() == 1
@@ -306,29 +360,26 @@ def async_dispatch_enabled() -> bool:
 
     ``HEAT_TPU_ASYNC_DISPATCH=0`` restores the fully lock-serialized force
     (plan AND program call under the executor lock, direct memoisation — the
-    pre-scheduler executor, bit for bit). Read per force so tests and the
-    serving async-gate can flip it in-process."""
-    return os.environ.get("HEAT_TPU_ASYNC_DISPATCH", "1") != "0"
+    pre-scheduler executor, bit for bit). Memoised off the per-force hot path;
+    tests and the serving async-gate flip it in-process via
+    :func:`reload_env_knobs`."""
+    return _knobs.async_dispatch
 
 
 def queue_bound() -> int:
     """Dispatch-queue capacity (``HEAT_TPU_DISPATCH_QUEUE``, default 256).
     A submit against a full queue is backpressure: retried under the
-    ``executor.queue`` resilience policy, then executed inline."""
-    try:
-        return max(1, int(os.environ.get("HEAT_TPU_DISPATCH_QUEUE", "256")))
-    except ValueError:
-        return 256
+    ``executor.queue`` resilience policy, then executed inline. Memoised; see
+    :func:`reload_env_knobs`."""
+    return _knobs.queue_bound
 
 
 def batch_max() -> int:
     """Cross-request batching width cap (``HEAT_TPU_BATCH_MAX``, default 8;
     ``1`` disables batching). Widths are bucketed to powers of two up to this
-    cap so each program compiles a bounded set of batched variants."""
-    try:
-        return max(1, int(os.environ.get("HEAT_TPU_BATCH_MAX", "8")))
-    except ValueError:
-        return 8
+    cap so each program compiles a bounded set of batched variants. Memoised;
+    see :func:`reload_env_knobs`."""
+    return _knobs.batch_max
 
 
 # ------------------------------------------------------- per-buffer ownership
@@ -531,13 +582,17 @@ def clear_executor_cache() -> None:
     counters are zeroed, and the per-signature breakdown of
     ``executor_stats(top=N)`` empties because the programs carrying those
     tallies are gone. After this call ``executor_stats()`` reports all zeros
-    and the next dispatch of any signature recompiles (a counted retrace)."""
+    and the next dispatch of any signature recompiles (a counted retrace).
+    Also one of the two documented re-read points for the memoised
+    ``HEAT_TPU_*`` dispatch knobs (:func:`reload_env_knobs`)."""
     with _lock:
         _programs.clear()
         _seen.clear()
-        _aval_cache.clear()
         _quarantined.clear()
+    with _aval_lock:
+        _aval_cache.clear()
     reset_executor_stats()
+    reload_env_knobs()
 
 
 # ------------------------------------------------------------------ diagnostics glue
@@ -977,12 +1032,9 @@ _MAX_QUARANTINED = 64
 
 def quarantine_threshold() -> int:
     """Failures of one signature before it is quarantined to the eager path
-    (``HEAT_TPU_QUARANTINE_AFTER``, default 3). Read per failure — never on a
-    success path."""
-    try:
-        return max(1, int(os.environ.get("HEAT_TPU_QUARANTINE_AFTER", "3")))
-    except ValueError:
-        return 3
+    (``HEAT_TPU_QUARANTINE_AFTER``, default 3). Memoised with the other
+    dispatch knobs; see :func:`reload_env_knobs`."""
+    return _knobs.quarantine_after
 
 
 def fallback_after_failure(key, prog: "_Program", exc: BaseException,
@@ -1059,7 +1111,14 @@ _MAX_FUSED_NODES = 256
 # aval is resolved once per signature and replayed. Keyed on id(op) — hashing a
 # jnp ufunc runs Python-level __hash__, too slow per dispatch — with the op
 # itself stored in the value so the id stays pinned for the entry's lifetime.
+# Guarded by its own tiny lock, NOT the executor lock: the deferral path exists
+# to stay off the big lock, but the pop/re-insert recency dance and the
+# evict-half loop are not GIL-atomic — two racing evictions can `del` a key the
+# other already removed. The critical sections are a handful of dict ops; the
+# slow eval_shape miss path runs outside the lock (a racing duplicate probe is
+# benign — last writer wins with an identical value).
 _aval_cache: Dict[Any, Any] = {}
+_aval_lock = threading.Lock()
 _MAX_AVALS = 4096
 
 
@@ -1204,9 +1263,11 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
     if phys_shape is None:
         return UNSUPPORTED
     akey = (id(operation), kwsig, tuple(sigs))
-    entry = _aval_cache.pop(akey, None)
+    with _aval_lock:
+        entry = _aval_cache.pop(akey, None)
+        if entry is not None:
+            _aval_cache[akey] = entry  # re-insert: recency order for eviction below
     if entry is not None:
-        _aval_cache[akey] = entry  # re-insert: recency order for eviction below
         aval = entry[1]
     else:
         specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for kind, v in operands if kind != "s"]
@@ -1230,16 +1291,18 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
                     f"{_op_label(operation)}: {type(exc).__name__}: {exc}",
                 )
             aval = UNSUPPORTED
-        if len(_aval_cache) >= _MAX_AVALS:
-            # evict the least-recently-USED half, not everything: a steady-state
-            # workload sitting near the limit must not periodically lose every
-            # cached aval (same policy as the _seen warm-up table; the pop/
-            # re-insert above keeps hit keys at the recent end)
-            for stale in list(_aval_cache)[: _MAX_AVALS // 2]:
-                del _aval_cache[stale]
-        # the stored operation pins its id: an id-keyed entry can never be
-        # aliased by a different (later-allocated) operation while it lives
-        _aval_cache[akey] = (operation, aval)
+        with _aval_lock:
+            if len(_aval_cache) >= _MAX_AVALS:
+                # evict the least-recently-USED half, not everything: a
+                # steady-state workload sitting near the limit must not
+                # periodically lose every cached aval (same policy as the
+                # _seen warm-up table; the pop/re-insert above keeps hit keys
+                # at the recent end)
+                for stale in list(_aval_cache)[: _MAX_AVALS // 2]:
+                    del _aval_cache[stale]
+            # the stored operation pins its id: an id-keyed entry can never be
+            # aliased by a different (later-allocated) operation while it lives
+            _aval_cache[akey] = (operation, aval)
     if aval is UNSUPPORTED:
         return UNSUPPORTED
     shape, dtype = aval
@@ -1331,16 +1394,21 @@ def _force_graph(roots: Tuple[Deferred, ...]) -> None:
         req = next((r.req for r in roots if r.req is not None), None)
         with profiler.scope(
             "force", f"force:{_op_label(roots[0].operation)}", req=req
-        ):
-            _force_graph_inner(roots)
+        ) as ctl:
+            if not _force_graph_inner(roots):
+                # lost the plan race to a concurrent force of the same roots:
+                # nothing planned or executed here, so drop the slice — the
+                # winner's force scope is the one covering the work
+                ctl["keep"] = False
         return
     _force_graph_inner(roots)
 
 
-def _force_graph_inner(roots: Tuple[Deferred, ...]) -> None:
+def _force_graph_inner(roots: Tuple[Deferred, ...]) -> bool:
+    """Returns True when this call planned work (executed, or submitted a
+    dispatch); False when every root was already forced/in flight."""
     if async_dispatch_enabled():
-        _force_async(roots)
-        return
+        return _force_async(roots)
     # serialized legacy path: settle any dispatch-done futures an earlier
     # async force left behind BEFORE taking the lock (the in-flight executor
     # may need the lock to finish — waiting under it would deadlock), then
@@ -1348,7 +1416,7 @@ def _force_graph_inner(roots: Tuple[Deferred, ...]) -> None:
     # executor did.
     _settle_pending_nodes(roots)
     with _tlock:
-        _force_sync_locked(roots)
+        return _force_sync_locked(roots)
 
 
 def _settle_pending_nodes(roots) -> None:
@@ -1441,7 +1509,16 @@ def _linearise(roots: Tuple[Deferred, ...]) -> Optional[_ForcePlan]:
                 # repr, not the value: equality would collapse numerically
                 # distinct scalars (-0.0 == 0.0, 1 == True) into one leaf slot
                 k = ("s", type(value), repr(value))
-            except Exception:  # unhashable scalar cannot happen, but stay safe
+            except Exception as exc:
+                # a scalar whose repr raises (exotic user subclass): fall back
+                # to identity keying — correct, just no cross-call leaf
+                # sharing — and leave a counted trace of the oddity
+                if diagnostics._enabled:
+                    diagnostics.record_fallback(
+                        "executor.leaf_sig",
+                        f"{type(value).__name__} repr failed: "
+                        f"{type(exc).__name__}: {exc}",
+                    )
                 k = ("s", id(value))
         idx = leaf_index.get(k)
         if idx is None:
@@ -1729,12 +1806,13 @@ def _record_force_memory(pl: _ForcePlan, outs) -> None:
     profiler.record_force_memory(live)
 
 
-def _force_sync_locked(roots: Tuple[Deferred, ...]) -> None:
+def _force_sync_locked(roots: Tuple[Deferred, ...]) -> bool:
     """The serialized executor: plan, call, and memoise under the lock —
-    today's ``HEAT_TPU_ASYNC_DISPATCH=0`` contract, bit for bit."""
+    today's ``HEAT_TPU_ASYNC_DISPATCH=0`` contract, bit for bit. Returns
+    False when there was nothing left to force."""
     pl = _linearise(roots)
     if pl is None:
-        return
+        return False
     prog = lookup(pl.key, _plan_builder(pl), label=pl.label)
     if prog is None:
         outs = _plan_replay_eager(pl)
@@ -1767,9 +1845,10 @@ def _force_sync_locked(roots: Tuple[Deferred, ...]) -> None:
     if profiler._active:
         _record_force_memory(pl, outs)
     _memoise(pl, outs)
+    return True
 
 
-def _force_async(roots: Tuple[Deferred, ...]) -> None:
+def _force_async(roots: Tuple[Deferred, ...]) -> bool:
     """The async executor: plan under the lock, dispatch outside it.
 
     Under the lock: linearise, look up the program, pick donations, claim the
@@ -1780,12 +1859,13 @@ def _force_async(roots: Tuple[Deferred, ...]) -> None:
     path is idle, else queued to the fair scheduler (where same-signature
     items batch). Warm-up / unsupported signatures replay op-by-op under the
     lock exactly like the serialized path: below-threshold forces never
-    queue."""
+    queue. Returns False when every root was already forced or in flight
+    (a lost plan race — nothing planned here), True otherwise."""
     sched = _get_scheduler()
     with _tlock:
         pl = _linearise(roots)
         if pl is None:
-            return
+            return False
         prog = lookup(pl.key, _plan_builder(pl), label=pl.label)
         if prog is None:
             # warm-up / unsupported / quarantined: the op-by-op replay is the
@@ -1799,7 +1879,7 @@ def _force_async(roots: Tuple[Deferred, ...]) -> None:
                 if profiler._active:
                     _record_force_memory(pl, outs)
                 _memoise(pl, outs)
-                return
+                return True
             donate_idx = ()
         else:
             donate_idx = _pick_donations(pl, prog)
@@ -1931,7 +2011,7 @@ def _force_async(roots: Tuple[Deferred, ...]) -> None:
             execute()
         finally:
             sched.end_inline()
-        return
+        return True
     tenant = None
     if profiler._active:
         tenant = profiler.current_request_tag()
@@ -1945,6 +2025,7 @@ def _force_async(roots: Tuple[Deferred, ...]) -> None:
         # the queue stayed full through the backpressure policy: run inline —
         # slower than queued+batched, but work is never dropped
         execute()
+    return True
 
 
 def _execute_batch(items) -> None:

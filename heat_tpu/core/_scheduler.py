@@ -216,7 +216,7 @@ class DispatchScheduler:
             if self._depth > self.queue_depth_peak:
                 self.queue_depth_peak = self._depth
             depth = self._depth
-            self._ensure_thread()
+            self._ensure_thread_locked()
             self._cv.notify_all()
         self._note_depth(depth)
         return True
@@ -226,8 +226,9 @@ class DispatchScheduler:
             return self._depth
 
     # ------------------------------------------------------------- drain loop
-    def _ensure_thread(self) -> None:
-        # called under _cv
+    def _ensure_thread_locked(self) -> None:
+        # called under _cv (the _locked suffix is the convention the invariant
+        # checker enforces for functions entered with the lock already held)
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
                 target=self._loop, name="heat-tpu-dispatch", daemon=True

@@ -412,7 +412,7 @@ class MeshCommunication(Communication):
         payload × participants. Callers gate on ``diagnostics._enabled`` so the
         disabled cost is one attribute read."""
         participants = self._axis_participants(axis_name)
-        diagnostics.record_collective(
+        diagnostics.record_collective(  # ht: ignore[trace-telemetry-unguarded] -- every caller gates on diagnostics._enabled (this helper's docstring contract); record_collective additionally self-gates
             op, axis_name or self.axis_name, participants,
             _payload_bytes(x) * participants,
         )

@@ -167,14 +167,14 @@ def _caps_cache_path() -> str:
         return override
     try:
         kind = jax.devices()[0].device_kind.replace(" ", "_").replace("/", "_")
-    except Exception:
-        kind = "unknown"
+    except (RuntimeError, IndexError, AttributeError):
+        kind = "unknown"  # backend not initialisable / no devices: generic key
     try:
         import jaxlib
 
         runtime = jaxlib.__version__  # capability limits live in the runtime build,
         # not the jax front-end — key on it so runtime up/downgrades re-probe
-    except Exception:
+    except (ImportError, AttributeError):
         runtime = "unknown"
     uid = os.getuid() if hasattr(os, "getuid") else 0
     name = (
@@ -206,8 +206,8 @@ def _read_caps_cache() -> Optional[dict]:
             if time.time() - float(data.get("time", 0)) > _FAILED_PROBE_TTL_S:
                 return None
         return {"complex": bool(data["complex"]), "fft": bool(data["fft"])}
-    except Exception:
-        return None
+    except (OSError, ValueError, KeyError, TypeError):
+        return None  # unreadable/malformed/foreign cache: treat as absent
 
 
 def _write_caps_cache(caps: dict, probe_ok: bool) -> None:
@@ -220,7 +220,7 @@ def _write_caps_cache(caps: dict, probe_ok: bool) -> None:
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, "w") as fh:
             json.dump({**caps, "probe_ok": probe_ok, "time": time.time()}, fh)
-    except Exception:
+    except OSError:
         pass  # cache is best-effort; the in-process memo still holds
 
 
@@ -322,11 +322,11 @@ def complex_needs_host(*dtypes_or_values) -> bool:
         rt = np.result_type(
             *[getattr(v, "dtype", v) for v in dtypes_or_values]
         ) if dtypes_or_values else None
-    except Exception:
+    except TypeError:
         try:
             rt = jnp.result_type(*dtypes_or_values)
-        except Exception:
-            return False
+        except TypeError:
+            return False  # unpromotable operand mix: not complex, no host hop
     if rt is None or not np.issubdtype(rt, np.complexfloating):
         return False
     return not complex_supported()

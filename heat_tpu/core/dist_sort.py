@@ -123,15 +123,15 @@ def distributed_sort(
             _SORTER_CACHE.clear()
         mesh, axis_name, nproc = comm.mesh, comm.axis_name, comm.size
         fn = jax.jit(
-            lambda v: _sort_impl(mesh, axis_name, nproc, v, axis, descending, n)
+            lambda v: _sort_impl(comm, mesh, axis_name, nproc, v, axis, descending, n)
         )
         _SORTER_CACHE[key] = fn
     return fn(value)
 
 
 def _sort_impl(
-    mesh, axis_name: str, nproc: int, value: jax.Array, axis: int, descending: bool,
-    n: int,
+    comm, mesh, axis_name: str, nproc: int, value: jax.Array, axis: int,
+    descending: bool, n: int,
 ) -> Tuple[jax.Array, jax.Array]:
     c = -(-n // nproc) if n else 0
     m = c * nproc
@@ -165,7 +165,9 @@ def _sort_impl(
         ops = jax.lax.sort(ops, dimension=axis, num_keys=2)
         for r, (partner, _) in enumerate(rounds):
             perm = [(src, partner[src]) for src in range(nproc)]
-            received = [jax.lax.ppermute(o, axis_name, perm) for o in ops]
+            received = [
+                comm.ppermute(o, perm, axis_name=axis_name) for o in ops
+            ]
             merged = jax.lax.sort(
                 tuple(
                     jnp.concatenate([o, ro], axis=axis)

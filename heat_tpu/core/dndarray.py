@@ -170,7 +170,7 @@ class DNDarray:
             # dispatch executor) declare their intent via _rebind_physical instead
             # of relying on shape coincidence (ADVICE r5 #1).
             return False
-        except Exception:
+        except Exception:  # ht: ignore[silent-except] -- layout-inference probe: False is the conservative verdict, and _rebind_physical is the intent-declared path (ADVICE r5 #1)
             return False
 
     def _rebind_physical(self, array: jax.Array) -> None:

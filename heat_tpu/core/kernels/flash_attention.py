@@ -22,6 +22,7 @@ this is TPU-first machinery for the long-context story.
 from __future__ import annotations
 
 import functools
+import os
 import math
 
 import jax
@@ -53,9 +54,8 @@ def _env_vmem_limit():
     """HEAT_TPU_FLASH_VMEM_LIMIT in bytes, or None when unset, malformed, or not
     positive (graceful degradation, like _env_blocks — a bad value must not take
     down every attention dispatch)."""
-    import os
 
-    raw = os.environ.get("HEAT_TPU_FLASH_VMEM_LIMIT")
+    raw = os.environ.get("HEAT_TPU_FLASH_VMEM_LIMIT")  # ht: ignore[trace-env-read] -- documented trace-time tuning knob (see docstring): kernel block geometry is necessarily a compile-time constant; re-tune in a fresh process
     if not raw:
         return None
     try:
@@ -84,9 +84,8 @@ def _env_blocks(default_bq: int, default_bk: int):
     Read at TRACE time: jit caches by shape/dtype, so changing the env between
     same-shape calls in one process reuses the first compilation — run each
     config in a fresh process (or clear jax caches) when sweeping."""
-    import os
 
-    spec = os.environ.get("HEAT_TPU_FLASH_BLOCKS")
+    spec = os.environ.get("HEAT_TPU_FLASH_BLOCKS")  # ht: ignore[trace-env-read] -- documented trace-time tuning knob (see docstring): kernel block geometry is necessarily a compile-time constant; re-tune in a fresh process
     if not spec:
         return default_bq, default_bk
     try:
@@ -232,9 +231,8 @@ def _pipeline_enabled() -> bool:
     the ceiling analysis in doc/source/flash_attention_perf.rst identifies as the
     gap between the ~33 and ~49 TFLOP/s bounds). Off by default until measured
     on hardware; read at trace time (same caveat as _env_blocks)."""
-    import os
 
-    return os.environ.get("HEAT_TPU_FLASH_PIPELINE") == "1"
+    return os.environ.get("HEAT_TPU_FLASH_PIPELINE") == "1"  # ht: ignore[trace-env-read] -- documented trace-time tuning knob (see docstring): kernel block geometry is necessarily a compile-time constant; re-tune in a fresh process
 
 
 def _kernel_pipelined(im_ref, jm_ref, flags_ref, q_ref, k_ref, v_ref, *refs,
@@ -354,8 +352,8 @@ def _pair_schedule(nq: int, nk: int, bq: int, bk: int, causal: bool):
 )
 def _flash_pallas(q, k, v, causal: bool, scale: float, bq: int, bk: int,
                   interpret: bool = False, bias=None, pipelined: bool = False):
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    import jax.experimental.pallas as pl  # ht: ignore[trace-lazy-import] -- pallas imports deferred so CPU-only processes never pay them; runs once per compile, imports nothing of heat_tpu
+    from jax.experimental.pallas import tpu as pltpu  # ht: ignore[trace-lazy-import] -- pallas imports deferred so CPU-only processes never pay them; runs once per compile, imports nothing of heat_tpu
 
     with jax.enable_x64(False):
         *batch, tq, d = q.shape

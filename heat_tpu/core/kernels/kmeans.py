@@ -42,7 +42,7 @@ def fused_assign_update_reference(
 
 
 def _kernel(nvalid_ref, x_ref, c_ref, labels_ref, sums_ref, counts_ref, sse_ref):
-    import jax.experimental.pallas as pl
+    import jax.experimental.pallas as pl  # ht: ignore[trace-lazy-import] -- pallas imports deferred so CPU-only processes never pay them; runs once per compile, imports nothing of heat_tpu
 
     i = pl.program_id(0)
     bn = x_ref.shape[0]
@@ -99,8 +99,8 @@ def _kernel(nvalid_ref, x_ref, c_ref, labels_ref, sums_ref, counts_ref, sse_ref)
 
 @functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
 def _fused_pallas(xv, centers, block_n: int = _DEFAULT_BLOCK_N, interpret: bool = False):
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    import jax.experimental.pallas as pl  # ht: ignore[trace-lazy-import] -- pallas imports deferred so CPU-only processes never pay them; runs once per compile, imports nothing of heat_tpu
+    from jax.experimental.pallas import tpu as pltpu  # ht: ignore[trace-lazy-import] -- pallas imports deferred so CPU-only processes never pay them; runs once per compile, imports nothing of heat_tpu
 
     # the framework enables x64 globally; Mosaic only legalizes i32 scalars, so the
     # kernel (all-i32/f32 by construction) is traced with x64 off

@@ -495,8 +495,8 @@ def _topk_split(a: DNDarray, k: int, dim: int, largest: bool):
             li = jnp.argsort(xv, axis=-1)[..., :k]
             vals = jnp.take_along_axis(xv, li, axis=-1)
         gi = li + r * c
-        cv = jax.lax.all_gather(vals, comm.axis_name, axis=last, tiled=True)
-        ci = jax.lax.all_gather(gi, comm.axis_name, axis=last, tiled=True)
+        cv = comm.all_gather(vals, axis=last)
+        ci = comm.all_gather(gi, axis=last)
         sel = jnp.argsort(cv, axis=-1, descending=largest, stable=True)[..., :k]
         fv = jnp.take_along_axis(cv, sel, axis=-1)
         fi = jnp.take_along_axis(ci, sel, axis=-1)

@@ -388,21 +388,28 @@ def scope(cat: str, name: str, req: Optional[int] = None):
     request scope is ambient (a deferred chain forced outside the scope that
     built it) the slice — and everything nested under it — attributes to
     ``req`` instead. Callers on hot paths gate on ``profiler._active``
-    themselves; this guard is for direct users."""
+    themselves; this guard is for direct users.
+
+    Yields a control handle: setting ``handle["keep"] = False`` before the
+    block exits discards the slice. The executor uses this for a force that
+    lost the plan race and had nothing to execute — recording it would put a
+    phantom empty ``force`` on the timeline."""
     if not _active:
-        yield
+        yield {"keep": True}
         return
     token = None
     if req is not None and _current_request.get() is None:
         token = _current_request.set(req)
     rid = _current_request.get()
+    ctl = {"keep": True}
     t0 = _now_us()
     try:
-        yield
+        yield ctl
     finally:
         t1 = _now_us()
-        with _lock:
-            _slices.append((rid, threading.get_ident(), str(cat), str(name), t0, t1))
+        if ctl["keep"]:
+            with _lock:
+                _slices.append((rid, threading.get_ident(), str(cat), str(name), t0, t1))
         if token is not None:
             _current_request.reset(token)
 
@@ -558,5 +565,5 @@ if _trace_path and __package__:
     def _dump_trace_at_exit(path: str = _trace_path) -> None:  # pragma: no cover - exit hook
         try:
             dump_trace(path)
-        except Exception:
+        except Exception:  # ht: ignore[silent-except] -- atexit hook: raising here would mask the process's real exit status
             pass

@@ -16,6 +16,7 @@ than the reference's Kundu-transform approximation (``random.py:247``) — numer
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Tuple, Union
 
 import numpy as np
@@ -50,6 +51,14 @@ __all__ = [
 # Global (seed, counter) state, mirroring the reference's module state (random.py:40-44).
 __seed: int = 0
 __counter: int = 0
+# The counter advance is a read-modify-write: under the async executor two
+# serving threads drawing concurrently could reserve the SAME counter range
+# and emit duplicate streams. Every access to the (seed, counter) PAIR is
+# atomic under this lock — a draw must snapshot the seed its reserved range
+# belongs to (a concurrent reseed between the two reads would pair the new
+# seed with a stale counter and reproduce a later draw's key exactly). The
+# key derivation itself stays outside — it is pure in (seed, base).
+_state_lock = threading.Lock()
 
 
 def _next_key(nelem: int) -> jax.Array:
@@ -57,13 +66,15 @@ def _next_key(nelem: int) -> jax.Array:
     count — the property that makes streams independent of the device count
     (reference ``__counter_sequence`` ``random.py:56``)."""
     global __counter
+    with _state_lock:
+        sd = __seed
+        base = __counter
+        __counter = base + int(nelem)
     # fold the counter in 32-bit limbs so the stream never wraps (the reference's
     # Threefry counter is effectively 128-bit, random.py:56)
-    lo = __counter & 0xFFFFFFFF
-    hi = (__counter >> 32) & 0xFFFFFFFF
-    key = jax.random.fold_in(jax.random.fold_in(jax.random.key(__seed), hi), lo)
-    __counter += int(nelem)
-    return key
+    lo = base & 0xFFFFFFFF
+    hi = (base >> 32) & 0xFFFFFFFF
+    return jax.random.fold_in(jax.random.fold_in(jax.random.key(sd), hi), lo)
 
 
 def _wrap(value: jax.Array, dtype, split, device, comm) -> DNDarray:
@@ -76,7 +87,8 @@ def _wrap(value: jax.Array, dtype, split, device, comm) -> DNDarray:
 
 def get_state() -> Tuple[str, int, int, int, float]:
     """Return the internal state of the generator (reference ``random.py:202``)."""
-    return ("Threefry", __seed, __counter, 0, 0.0)
+    with _state_lock:
+        return ("Threefry", __seed, __counter, 0, 0.0)
 
 
 def set_state(state: Tuple[str, int, int, int, float]) -> None:
@@ -84,8 +96,9 @@ def set_state(state: Tuple[str, int, int, int, float]) -> None:
     if state[0] != "Threefry":
         raise ValueError(f"random state must be of type Threefry, got {state[0]}")
     global __seed, __counter
-    __seed = int(state[1])
-    __counter = int(state[2])
+    with _state_lock:
+        __seed = int(state[1])
+        __counter = int(state[2])
 
 
 def seed(seed: Optional[int] = None) -> None:
@@ -93,8 +106,9 @@ def seed(seed: Optional[int] = None) -> None:
     global __seed, __counter
     if seed is None:
         seed = np.random.SeedSequence().entropy % (2**32)
-    __seed = int(seed)
-    __counter = 0
+    with _state_lock:
+        __seed = int(seed)
+        __counter = 0
 
 
 def normal(

@@ -285,10 +285,11 @@ def set_policy(site: str, policy: Optional[Policy]) -> None:
             if not isinstance(policy, Policy):
                 raise TypeError(f"expected a Policy, got {type(policy)}")
             _site_policies[site] = policy
-        _refresh_active()
+        _refresh_active_locked()
 
 
-def _refresh_active() -> None:
+def _refresh_active_locked() -> None:
+    # called with _lock held (the _locked-suffix convention ht.analysis checks)
     global _active
     _active = _armed or bool(_site_policies)
 
@@ -555,7 +556,7 @@ def arm_fault_plan(plan: Union[None, str, Sequence[dict]] = None) -> None:
         _plan.update(parsed)
         _site_calls.clear()
         _armed = bool(_plan)
-        _refresh_active()
+        _refresh_active_locked()
     _record_event(
         "plan", "armed",
         f"{sum(len(v) for v in parsed.values())} entries at {sorted(parsed)}",
@@ -569,7 +570,7 @@ def disarm_fault_plan() -> None:
         _plan.clear()
         _site_calls.clear()
         _armed = False
-        _refresh_active()
+        _refresh_active_locked()
 
 
 def fault_plan() -> List[dict]:

@@ -43,7 +43,9 @@ def _convolve_overlap_add(comm, av: jax.Array, vv: jax.Array, n: int, m: int) ->
     def body(al, vl):
         y = jnp.convolve(al.reshape(-1), vl.reshape(-1), mode="full")  # c+m-1
         tail = y[c:]  # my halo into the next shard's head
-        recv = jax.lax.ppermute(tail, axis, [(i, i + 1) for i in range(nproc - 1)])
+        recv = comm.ppermute(
+            tail, [(i, i + 1) for i in range(nproc - 1)], axis_name=axis
+        )
         out = y[:c].at[: m - 1].add(recv)
         return out, tail
 

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..core.kernels.flash_attention import flash_attention, use_flash
+
 from ..core.dndarray import DNDarray
 
 __all__ = [
@@ -90,7 +92,6 @@ def _dense_attention(q, k, v, mask=None, is_causal=False, scale=None):
     block-even shapes run the flash Pallas kernel (streaming VMEM, no (T,T)
     score matrix in HBM); everything else takes the XLA path below.
     """
-    from ..core.kernels.flash_attention import flash_attention, use_flash
 
     if use_flash(q, k, v, mask, scale):
         return flash_attention(q, k, v, is_causal, scale, mask)
@@ -248,7 +249,7 @@ def ring_attention(q, k, v, axis_name: str, is_causal: bool = False,
     softmax; k/v rotate one neighbour per step (ppermute), so no device ever holds
     more than 1/P of the keys. Equivalent to dense softmax(qkᵀ)v up to fp error.
     """
-    p = lax.psum(1, axis_name)
+    p = lax.psum(1, axis_name)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
     my = lax.axis_index(axis_name)
     tq = q.shape[-2]
     tk = k.shape[-2]
@@ -271,8 +272,8 @@ def ring_attention(q, k, v, axis_name: str, is_causal: bool = False,
     def step(carry, step_idx):
         k_c, v_c, o, m, l = carry
         o, m, l = attend(o, m, l, k_c, v_c, (my + step_idx) % p)
-        k_next = lax.ppermute(k_c, axis_name, perm)
-        v_next = lax.ppermute(v_c, axis_name, perm)
+        k_next = lax.ppermute(k_c, axis_name, perm)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
+        v_next = lax.ppermute(v_c, axis_name, perm)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
         return (k_next, v_next, o, m, l), None
 
     # scan only the p-1 steps that are followed by a rotation; the last block is
@@ -349,7 +350,7 @@ def ring_attention_zigzag(q, k, v, axis_name: str, scale: Optional[float] = None
     q/k/v: local (..., 2c, D) chunks where the first ``c`` rows are the device's
     LOW chunk and the last ``c`` its HIGH chunk. Output is in the same layout.
     """
-    p = lax.psum(1, axis_name)
+    p = lax.psum(1, axis_name)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
     my = lax.axis_index(axis_name)
     two_c = q.shape[-2]
     c = two_c // 2
@@ -411,14 +412,14 @@ def ring_attention_zigzag(q, k, v, axis_name: str, scale: Optional[float] = None
         # rotate the HELD pair onward while attending it — both only read kc/vc,
         # so the ICI transfer overlaps the matmuls (same structure as the plain
         # ring); the final pair is consumed outside the scan with no dead hop
-        k_next = lax.ppermute(kc, axis_name, perm)
-        v_next = lax.ppermute(vc, axis_name, perm)
+        k_next = lax.ppermute(kc, axis_name, perm)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
+        v_next = lax.ppermute(vc, axis_name, perm)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
         acc_lo, acc_hi = attend_pair(kc, vc, (my + step_idx) % p, acc_lo, acc_hi)
         return (k_next, v_next, acc_lo, acc_hi), None
 
     if p > 1:
-        kc = lax.ppermute(k, axis_name, perm)
-        vc = lax.ppermute(v, axis_name, perm)
+        kc = lax.ppermute(k, axis_name, perm)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
+        vc = lax.ppermute(v, axis_name, perm)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
         if p > 2:
             (kc, vc, acc_lo, acc_hi), _ = lax.scan(
                 step, (kc, vc, acc_lo, acc_hi), jnp.arange(1, p - 1)
@@ -438,11 +439,11 @@ def ulysses_attention(q, k, v, axis_name: str, is_causal: bool = False,
     the full sequence for H/P heads, one all_to_all flips back.
     """
     # (B, H, T/P, D) -> (B, H/P, T, D): split heads axis (1), concat seq axis (2)
-    qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
-    kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
-    vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
+    kh = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
+    vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
     o = _dense_attention(qh, kh, vh, is_causal=is_causal, scale=scale)
-    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1, tiled=True)  # ht: ignore[collective-uncontracted] -- axis-name shard_map-body kernel API: no communicator in scope by design; callers (attention()/_ring_sharded) own the comm
 
 
 from .modules import Module

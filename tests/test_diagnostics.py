@@ -42,6 +42,7 @@ def setUpModule():
     global _OLD_THRESHOLD
     _OLD_THRESHOLD = os.environ.get("HEAT_TPU_JIT_THRESHOLD")
     os.environ["HEAT_TPU_JIT_THRESHOLD"] = "1"
+    _executor.reload_env_knobs()
 
 
 def tearDownModule():
@@ -49,6 +50,7 @@ def tearDownModule():
         os.environ.pop("HEAT_TPU_JIT_THRESHOLD", None)
     else:
         os.environ["HEAT_TPU_JIT_THRESHOLD"] = _OLD_THRESHOLD
+    _executor.reload_env_knobs()
 
 
 @contextlib.contextmanager
@@ -70,6 +72,7 @@ def metrics(trace=None):
 def eager_dispatch():
     old = os.environ.get("HEAT_TPU_EAGER_DISPATCH")
     os.environ["HEAT_TPU_EAGER_DISPATCH"] = "1"
+    _executor.reload_env_knobs()  # knobs are memoised: re-read after the flip
     try:
         yield
     finally:
@@ -77,6 +80,7 @@ def eager_dispatch():
             del os.environ["HEAT_TPU_EAGER_DISPATCH"]
         else:
             os.environ["HEAT_TPU_EAGER_DISPATCH"] = old
+        _executor.reload_env_knobs()
 
 
 def _chain64(x, y):

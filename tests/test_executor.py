@@ -54,6 +54,7 @@ def setUpModule():
     global _OLD_THRESHOLD
     _OLD_THRESHOLD = os.environ.get("HEAT_TPU_JIT_THRESHOLD")
     os.environ["HEAT_TPU_JIT_THRESHOLD"] = "1"
+    _executor.reload_env_knobs()
 
 
 def tearDownModule():
@@ -61,6 +62,7 @@ def tearDownModule():
         os.environ.pop("HEAT_TPU_JIT_THRESHOLD", None)
     else:
         os.environ["HEAT_TPU_JIT_THRESHOLD"] = _OLD_THRESHOLD
+    _executor.reload_env_knobs()
 
 
 @contextlib.contextmanager
@@ -68,6 +70,7 @@ def eager_dispatch():
     """Force the fully eager dispatch path (the executor's escape hatch)."""
     old = os.environ.get("HEAT_TPU_EAGER_DISPATCH")
     os.environ["HEAT_TPU_EAGER_DISPATCH"] = "1"
+    _executor.reload_env_knobs()  # knobs are memoised: re-read after the flip
     try:
         yield
     finally:
@@ -75,6 +78,7 @@ def eager_dispatch():
             del os.environ["HEAT_TPU_EAGER_DISPATCH"]
         else:
             os.environ["HEAT_TPU_EAGER_DISPATCH"] = old
+        _executor.reload_env_knobs()
 
 
 def _np_pair(shape, dtype=np.float32, seed=0):
@@ -773,6 +777,7 @@ class TestMultiOutputFusedGraphs(TestCase):
                 os.environ.pop("HEAT_TPU_JIT_THRESHOLD", None)
             else:
                 os.environ["HEAT_TPU_JIT_THRESHOLD"] = old
+            _executor.reload_env_knobs()
 
     def test_deep_diamond_dag_stays_one_program(self):
         # fusion-window accounting: per-edge size sums double per level of a
@@ -838,6 +843,7 @@ def _env(name, value):
         os.environ.pop(name, None)
     else:
         os.environ[name] = value
+    _executor.reload_env_knobs()  # knobs are memoised: re-read after the flip
     try:
         yield
     finally:
@@ -845,6 +851,7 @@ def _env(name, value):
             os.environ.pop(name, None)
         else:
             os.environ[name] = old
+        _executor.reload_env_knobs()
 
 
 class TestAsyncExecutor(TestCase):
@@ -1203,6 +1210,7 @@ class TestAsyncFailureDelivery(TestCase):
         with contextlib.ExitStack() as stack:
             old = os.environ.get("HEAT_TPU_JIT_THRESHOLD")
             os.environ["HEAT_TPU_JIT_THRESHOLD"] = "5"
+            stack.callback(_executor.reload_env_knobs)  # runs after the env restore below
             stack.callback(
                 lambda: os.environ.update({"HEAT_TPU_JIT_THRESHOLD": old})
                 if old is not None

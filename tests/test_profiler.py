@@ -41,6 +41,7 @@ def setUpModule():
     global _OLD_THRESHOLD
     _OLD_THRESHOLD = os.environ.get("HEAT_TPU_JIT_THRESHOLD")
     os.environ["HEAT_TPU_JIT_THRESHOLD"] = "1"
+    _executor.reload_env_knobs()
 
 
 def tearDownModule():
@@ -48,6 +49,7 @@ def tearDownModule():
         os.environ.pop("HEAT_TPU_JIT_THRESHOLD", None)
     else:
         os.environ["HEAT_TPU_JIT_THRESHOLD"] = _OLD_THRESHOLD
+    _executor.reload_env_knobs()
 
 
 class _ProfTestCase(TestCase):

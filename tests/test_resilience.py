@@ -42,6 +42,7 @@ def setUpModule():
     global _OLD_THRESHOLD
     _OLD_THRESHOLD = os.environ.get("HEAT_TPU_JIT_THRESHOLD")
     os.environ["HEAT_TPU_JIT_THRESHOLD"] = "1"
+    _executor.reload_env_knobs()
 
 
 def tearDownModule():
@@ -49,6 +50,7 @@ def tearDownModule():
         os.environ.pop("HEAT_TPU_JIT_THRESHOLD", None)
     else:
         os.environ["HEAT_TPU_JIT_THRESHOLD"] = _OLD_THRESHOLD
+    _executor.reload_env_knobs()
 
 
 class _ResilienceCase(TestCase):
@@ -380,6 +382,7 @@ class TestChaosExecutor(_ResilienceCase):
         np_a = np.arange(12, dtype=np.float32)
         _executor.clear_executor_cache()
         os.environ["HEAT_TPU_QUARANTINE_AFTER"] = "3"
+        _executor.reload_env_knobs()
         try:
             resilience.arm_fault_plan(
                 [{"site": "executor.execute", "on_call": 1, "count": 9999, "kind": "raise"}]
@@ -396,6 +399,7 @@ class TestChaosExecutor(_ResilienceCase):
             self.assertIn("failure 3", reason)
         finally:
             os.environ.pop("HEAT_TPU_QUARANTINE_AFTER", None)
+            _executor.reload_env_knobs()
         # quarantined: later identical dispatches take the eager path and stay correct
         x = ht.array(np_a, split=0)
         np.testing.assert_array_equal(((x + 1.0) * 3.0).numpy(), (np_a + 1.0) * 3.0)
