@@ -20,7 +20,12 @@ Every request runs inside ``ht.profiler.request(tag)``, so the emitted records
 carry the profiler's log-bucketed latency-histogram snapshots (mergeable
 offline across rounds/shards) next to the exact percentiles, and
 ``--trace-out`` dumps the whole run as a Chrome/Perfetto trace with one track
-per request.
+per request. Each record also attaches a ``scheduler`` block — the dispatch
+queue's pressure over that load loop (``queue_full_events``,
+``queue_depth_peak``, queued dispatches, and the lifecycle ledger's
+shed/expired/cancelled deltas; the mixed scenario breaks the ledger down
+``per_workload``) — so overload behaviour is visible in the bench trajectory
+even relay-down.
 
 Output is one BENCH-style JSON line per (workload, mode)::
 
@@ -78,6 +83,7 @@ def _bootstrap(devices: int) -> None:
         "HEAT_TPU_ASYNC_DISPATCH",
         "HEAT_TPU_DISPATCH_QUEUE",
         "HEAT_TPU_BATCH_MAX",
+        "HEAT_TPU_SHED",
     ):
         env.pop(knob, None)
     flags = [
@@ -213,6 +219,56 @@ def _gate_closed(rec: dict, envelope, emit) -> bool:
     return failed
 
 
+def _sched_snapshot() -> dict:
+    """The executor-stats fields that describe scheduler pressure (cumulative
+    since process start; records attach per-case deltas)."""
+    import heat_tpu as ht
+
+    s = ht.executor_stats()
+    return {
+        "queue_full_events": s["queue_full_events"],
+        "queue_depth_peak": s["queue_depth_peak"],
+        "queued_dispatches": s["queued_dispatches"],
+        "shed": s["shed_requests"],
+        "expired": s["expired_requests"],
+        "cancelled": s["cancelled_requests"],
+        "by_tenant": s["lifecycle_by_tenant"],
+    }
+
+
+def _sched_pressure(before: dict, after: dict, tags=None) -> dict:
+    """Scheduler-pressure delta for one load loop, attached to its record so
+    overload behaviour (queue-full backpressure, shed/cancel/expiry) is
+    visible in the bench trajectory even relay-down. ``queue_depth_peak`` is
+    a process-lifetime high-water mark, not a delta. ``tags`` (the mixed
+    scenario's request tags) adds a per-workload breakdown keyed by the
+    middle tag component."""
+    out = {
+        k: after[k] - before[k]
+        for k in ("queue_full_events", "queued_dispatches", "shed",
+                  "expired", "cancelled")
+    }
+    out["queue_depth_peak"] = after["queue_depth_peak"]
+    if tags:
+        per = {}
+        for tag in tags:
+            b = before["by_tenant"].get(tag, {})
+            a = after["by_tenant"].get(tag, {})
+            delta = {
+                "shed": a.get("shed", 0) - b.get("shed", 0),
+                "expired": (a.get("deadline_expired", 0)
+                            - b.get("deadline_expired", 0)),
+                "cancelled": a.get("cancelled", 0) - b.get("cancelled", 0),
+            }
+            parts = tag.split(".")
+            name = parts[1] if len(parts) == 3 else parts[0]
+            agg = per.setdefault(name, {"shed": 0, "expired": 0, "cancelled": 0})
+            for k, v in delta.items():
+                agg[k] += v
+        out["per_workload"] = per
+    return out
+
+
 def _merged_hist(profiler, tags):
     """Fold the per-tag request histograms into one snapshot (the mixed
     scenario's aggregate) using the histogram's exact bucket-count merge."""
@@ -299,12 +355,17 @@ def run(
     def one_case(name, pick, tags):
         nonlocal failed
         tag_closed = [f"{t}.closed" for t in tags]
+        sched_before = _sched_snapshot()
         pairs, wall = _load_loop(
             profiler, suffixed(pick, "closed"), requests, concurrency,
         )
         lats = [lat for _, lat in pairs]
         hist = _merged_hist(profiler, tag_closed)
         rec = _record(name, "closed", lats, wall, ndev, concurrency, hist)
+        rec["scheduler"] = _sched_pressure(
+            sched_before, _sched_snapshot(),
+            tags=tag_closed if len(tags) > 1 else None,
+        )
         if len(tags) > 1:
             rec["per_workload"] = _per_workload_ms(pairs)
         records.append(rec)
@@ -316,6 +377,7 @@ def run(
         offered = open_rps.get(name) or max(0.5, open_fraction * closed_rps)
         n_open = max(8, (2 * requests) // 3)
         tag_open = [f"{t}.open" for t in tags]
+        sched_before = _sched_snapshot()
         pairs, wall = _load_loop(
             profiler, suffixed(pick, "open"), n_open, concurrency,
             arrivals=_poisson_arrivals(n_open, offered),
@@ -324,6 +386,10 @@ def run(
         hist = _merged_hist(profiler, tag_open)
         rec = _record(name, "open", lats, wall, ndev, concurrency, hist,
                       offered_rps=offered)
+        rec["scheduler"] = _sched_pressure(
+            sched_before, _sched_snapshot(),
+            tags=tag_open if len(tags) > 1 else None,
+        )
         if len(tags) > 1:
             rec["per_workload"] = _per_workload_ms(pairs)
         records.append(rec)

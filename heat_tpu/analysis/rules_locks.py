@@ -92,7 +92,9 @@ LOCK_POLICY: Dict[str, ModulePolicy] = {
             "_slices", "_counter_events", "_requests", "_hists", "_mem",
             "_counters",
         }},
-        relaxed={"_active", "_trace_path"},
+        # _deadline_seen: the set-once lifecycle gate (docstring "Request
+        # deadlines" section) — relaxed like _active
+        relaxed={"_active", "_trace_path", "_deadline_seen"},
     ),
     # resilience.py zero-cost contract: _armed/_active are the relaxed gate
     # attributes; plan/breaker/policy registries mutate under _lock.
@@ -123,11 +125,14 @@ LOCK_POLICY: Dict[str, ModulePolicy] = {
 
 CLASS_POLICY: List[ClassPolicy] = [
     # _scheduler.DispatchScheduler: queue state + telemetry mutate under _cv
-    # ("telemetry (mutated under _cv; read via stats())").
+    # ("telemetry (mutated under _cv; read via stats())"), including the
+    # ISSUE 10 lifecycle state (draining flag + shed/cancel/expiry ledger).
     ClassPolicy(_SCHED, "DispatchScheduler", "_cv", {
         "_queues", "_by_key", "_depth", "_active", "_paused", "_thread",
+        "_draining",
         "queue_depth_peak", "batched_requests", "batch_width_hist",
-        "submitted", "inline_runs", "queue_full_events",
+        "submitted", "inline_runs", "queue_full_events", "drain_rejects",
+        "lifecycle", "tenant_lifecycle",
     }),
     # _executor._Stats: the cell list / retired / baseline fold under
     # _cells_lock (per-thread cells themselves are lock-free by design).

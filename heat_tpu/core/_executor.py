@@ -84,6 +84,32 @@ runs inline — work is never dropped. Failures inside a queued execution take
 the same :func:`fallback_after_failure` + ``replay_eager`` path as the
 serialized executor, so chaos plans cannot lose data by firing mid-queue.
 
+**Request lifecycle (ISSUE 10).** A force can carry a wall-clock **deadline**:
+``profiler.request(tag, deadline_s=...)`` arms it in the request's contextvar
+scope, every :class:`Deferred` captures it at defer time (exactly like
+``Deferred.req``), and the earliest deadline over a force's roots rides the
+:class:`_ForcePlan` and the queued :class:`~._scheduler.WorkItem`. The
+executor then refuses to spend capacity on work that can no longer meet it,
+at every checkpoint that is safe to interrupt — **admission** (a force whose
+deadline already passed raises a typed ``ht.resilience.DeadlineExceeded``
+before planning; with ``HEAT_TPU_SHED=1``, SLO-aware admission control also
+sheds work whose per-signature service-time EWMA — ``_Program.ewma_s``, the
+same quantity the profiler's ``service.<label>`` histograms record — cannot
+fit in the remaining budget), **pre-dispatch** (the scheduler cancels expired
+queued items and excludes expired peers from batch formation), and **between
+ops of the eager replay** (:func:`_plan_replay_eager` checks the deadline per
+plan entry). ``HEAT_TPU_SHED=1`` additionally turns queue-full backpressure
+exhaustion into a typed ``Shed`` for deadline-bearing requests instead of
+inline execution, so overload sheds infeasible work rather than serialising
+everyone behind it. Lifecycle verbs live on the scheduler
+(``cancel(tag)`` / ``drain(timeout)`` / ``reopen()``), an atexit drain
+guarantees interpreter shutdown fulfils every outstanding ``PendingValue``
+with a value or a typed error, and every shed/cancel/expiry lands in the
+scheduler's lifecycle ledger (``executor_stats()``, diagnostics counters,
+and the profiler's ``lifecycle.<kind>`` Perfetto counter tracks). With no
+deadline armed, every checkpoint is a single attribute read — the
+deadline-off dispatch ops/s and HLO-parity gates keep enforcing that.
+
 Escape hatch: ``HEAT_TPU_EAGER_DISPATCH=1`` disables the executor entirely and
 restores the fully eager dispatch path for debugging. Introspection:
 :func:`executor_stats` (hits / misses / retraces / cache size / queue + batch
@@ -93,6 +119,7 @@ microbenchmark.
 
 from __future__ import annotations
 
+import atexit
 import os
 import sys
 import threading
@@ -287,7 +314,7 @@ _MAX_SEEN = 8192
 class _EnvKnobs:
     __slots__ = (
         "eager_dispatch", "async_dispatch", "jit_threshold",
-        "queue_bound", "batch_max", "quarantine_after",
+        "queue_bound", "batch_max", "quarantine_after", "shed",
     )
 
     def reload(self) -> None:
@@ -303,6 +330,7 @@ class _EnvKnobs:
         self.queue_bound = _int("HEAT_TPU_DISPATCH_QUEUE", 256)
         self.batch_max = _int("HEAT_TPU_BATCH_MAX", 8)
         self.quarantine_after = _int("HEAT_TPU_QUARANTINE_AFTER", 3)
+        self.shed = os.environ.get("HEAT_TPU_SHED") == "1"
 
 
 _knobs = _EnvKnobs()
@@ -314,7 +342,7 @@ def reload_env_knobs() -> None:
 
     The knobs (``HEAT_TPU_EAGER_DISPATCH`` / ``ASYNC_DISPATCH`` /
     ``JIT_THRESHOLD`` / ``DISPATCH_QUEUE`` / ``BATCH_MAX`` /
-    ``QUARANTINE_AFTER``) are parsed once at import and memoised off the hot
+    ``QUARANTINE_AFTER`` / ``SHED``) are parsed once at import and memoised off the hot
     dispatch path; in-process environment mutations take effect at the next
     call to this function (or to :func:`clear_executor_cache`, which re-reads
     as part of dropping the program table)."""
@@ -380,6 +408,16 @@ def batch_max() -> int:
     cap so each program compiles a bounded set of batched variants. Memoised;
     see :func:`reload_env_knobs`."""
     return _knobs.batch_max
+
+
+def shed_enabled() -> bool:
+    """Whether load-shedding admission control is on (``HEAT_TPU_SHED=1``).
+    Shedding only changes behaviour for DEADLINE-bearing requests: infeasible
+    work (service-time EWMA past the remaining budget) and queue-full
+    backpressure exhaustion deliver a typed ``ht.resilience.Shed`` instead of
+    executing; requests without a deadline are never shed. Memoised; see
+    :func:`reload_env_knobs`."""
+    return _knobs.shed
 
 
 # ------------------------------------------------------- per-buffer ownership
@@ -503,6 +541,22 @@ def executor_stats(top: int = 0) -> dict:
     - ``donation_refusals`` — leaf donations the per-buffer ownership registry
       refused because another in-flight call still owned the buffer.
 
+    Request-lifecycle ledger (ISSUE 10; every shed/cancel/expiry is counted —
+    nothing is silently dropped):
+
+    - ``expired_requests`` — forces refused at admission, cancelled
+      pre-dispatch, or interrupted between replay ops because their wall-clock
+      deadline had passed (typed ``DeadlineExceeded`` delivered).
+    - ``shed_requests`` — deadline-bearing forces rejected by
+      ``HEAT_TPU_SHED=1`` admission control (infeasible per the service-time
+      EWMA, or queue-full through backpressure) with a typed ``Shed``; also
+      items shed by a timed-out ``drain``.
+    - ``cancelled_requests`` — queued items cancelled by
+      ``DispatchScheduler.cancel(tag)`` (typed ``RequestCancelled``).
+    - ``drain_rejects`` / ``draining`` — submits refused because admission is
+      closed, and whether it currently is.
+    - ``lifecycle_by_tenant`` — the same ledger broken down by request tag.
+
     ``top > 0`` adds ``top_signatures``: the N hottest compiled programs by
     lifetime replay count, each as ``{"label", "hits", "compile_s"}`` —
     ``label`` names the dispatch family and operation (``"defer:add..add[64]"``,
@@ -534,6 +588,12 @@ def executor_stats(top: int = 0) -> dict:
         stats["queue_full_events"] = sstats["queue_full_events"]
         stats["inline_dispatches"] = sstats["inline_runs"]
         stats["queued_dispatches"] = sstats["submitted"]
+        stats["shed_requests"] = sstats["lifecycle"]["shed"]
+        stats["expired_requests"] = sstats["lifecycle"]["deadline_expired"]
+        stats["cancelled_requests"] = sstats["lifecycle"]["cancelled"]
+        stats["drain_rejects"] = sstats["drain_rejects"]
+        stats["draining"] = sstats["draining"]
+        stats["lifecycle_by_tenant"] = sstats["tenant_lifecycle"]
     else:
         stats["queue_depth_peak"] = 0
         stats["batched_requests"] = 0
@@ -541,6 +601,12 @@ def executor_stats(top: int = 0) -> dict:
         stats["queue_full_events"] = 0
         stats["inline_dispatches"] = 0
         stats["queued_dispatches"] = 0
+        stats["shed_requests"] = 0
+        stats["expired_requests"] = 0
+        stats["cancelled_requests"] = 0
+        stats["drain_rejects"] = 0
+        stats["draining"] = False
+        stats["lifecycle_by_tenant"] = {}
     with _lock:
         stats["quarantined"] = dict(_quarantined)
     if top > 0:
@@ -745,7 +811,7 @@ class _Program:
     __slots__ = (
         "body", "out_shardings", "donate_index", "meta",
         "label", "hits", "compile_s", "arg_specs", "_plain", "_donating",
-        "_variants", "_batched", "failures", "proven",
+        "_variants", "_batched", "failures", "proven", "ewma_s",
     )
 
     def __init__(self, body, out_shardings, donate_index, meta):
@@ -763,6 +829,19 @@ class _Program:
         self._batched = None  # width -> jitted vmap variant (cross-request batching)
         self.failures = 0   # compile/execute failures (fallback_after_failure)
         self.proven = False  # at least one call of any variant has succeeded
+        # Service-time EWMA over REPLAY dispatches (first calls are compile
+        # time, not service time), the estimate behind HEAT_TPU_SHED admission
+        # control. It measures host-side DISPATCH wall time — jax calls return
+        # once dispatched, before device execution finishes — so for
+        # device-bound programs it is a LOWER bound on true service time and
+        # the admission check is conservative: it can under-shed (wall-clock
+        # expiry still catches that work late), never reject feasible work.
+        # In this stack's serving regime (relay round-trip + host dispatch
+        # dominated) dispatch time IS the bulk of service time. Deliberately
+        # relaxed (last-writer-wins float; a lost update nudges the estimate
+        # by one sample) — the same quantity lands in the profiler's
+        # `service.<label>` histograms when it is collecting.
+        self.ewma_s = 0.0
 
     def _traced(self):
         body = self.body
@@ -780,7 +859,35 @@ class _Program:
 
         return counted
 
+    def _lifecycle_check(self) -> None:
+        """Admission checkpoint for STAGED dispatches — the one-op programs
+        the four dispatch wrappers call directly, which never pass through the
+        deferred force's plan admission. Host-side attr reads only (nothing
+        enters the traced body): an ambient deadline that has already passed
+        raises a typed ``DeadlineExceeded`` before any dispatch, and with
+        ``HEAT_TPU_SHED=1`` a budget the service-time EWMA cannot fit raises
+        ``Shed`` — both travel through :func:`fallback_after_failure`, which
+        counts them and tells the wrapper to re-raise rather than replay
+        (executing over-deadline work late is what the deadline prevents)."""
+        dl = profiler.current_deadline()
+        if dl is None:
+            return
+        now = time.monotonic()
+        if now >= dl:
+            raise resilience.DeadlineExceeded(
+                f"deadline passed before dispatch ({self.label or 'program'})"
+            )
+        if _knobs.shed and self.ewma_s > 0.0 and now + self.ewma_s >= dl:
+            raise resilience.Shed(
+                f"admission control: estimated service time "
+                f"{self.ewma_s * 1e3:.2f} ms exceeds the remaining deadline "
+                f"budget ({self.label or 'program'})"
+            )
+
     def __call__(self, *args, donate: bool = False, donate_leaves: Tuple[int, ...] = ()):
+        if profiler._deadline_seen:
+            # one module-attribute read in processes that never arm a deadline
+            self._lifecycle_check()
         if resilience._armed:
             # every program call is one countable "executor.execute" event; the
             # fault fires BEFORE any dispatch, so argument buffers (including
@@ -855,7 +962,7 @@ class _Program:
                         if isinstance(a, jax.Array) else a
                         for a in args
                     )
-            t0 = time.perf_counter()
+        t0 = time.perf_counter()
         if profiler._active:
             # host-side timing only (never inside the traced body — the HLO
             # parity contract): the first call spans trace + XLA compile +
@@ -874,13 +981,25 @@ class _Program:
                 out = fn(*args)
         else:
             out = fn(*args)
+        dt = time.perf_counter() - t0
         if first:
-            dt = time.perf_counter() - t0
             self.compile_s += dt
             if diagnostics._enabled:
                 diagnostics.record_compile(self.label or "program", dt)
+        else:
+            self._note_service(dt)
         self.proven = True
         return out
+
+    def _note_service(self, dt: float, items: int = 1) -> None:
+        """Fold one replay's wall time into the service-time EWMA (relaxed
+        write — see the ``ewma_s`` comment) and, when the profiler is
+        collecting, into the ``service.<label>`` histogram it feeds."""
+        per = dt / items
+        prev = self.ewma_s
+        self.ewma_s = per if prev <= 0.0 else prev + 0.25 * (per - prev)
+        if profiler._active:
+            profiler.observe(f"service.{self.label or 'program'}", per)
 
     def call_batched(self, width: int, array_pos: Tuple[int, ...],
                      scalar_pos: Tuple[int, ...], flat_arrays: Sequence,
@@ -941,21 +1060,24 @@ class _Program:
                     fn = self._batched[width] = jax.jit(
                         batched_body, out_shardings=inner * width
                     )
-            t0 = time.perf_counter()
         if resilience._armed:
             resilience.maybe_fault("executor.execute")
         args = tuple(flat_arrays) + tuple(scalars)
         label = f"{self.label or 'program'}[x{width}]"
+        t0 = time.perf_counter()
         if profiler._active:
             with profiler.scope("compile" if first else "execute", label):
                 out = fn(*args)
         else:
             out = fn(*args)
+        dt = time.perf_counter() - t0
         if first:
-            dt = time.perf_counter() - t0
             self.compile_s += dt
             if diagnostics._enabled:
                 diagnostics.record_compile(label, dt)
+        else:
+            # per-item service time: a width-N batch serves N requests in dt
+            self._note_service(dt, items=width)
         self.proven = True
         return out
 
@@ -1042,14 +1164,25 @@ def fallback_after_failure(key, prog: "_Program", exc: BaseException,
     """Account one compiled-program failure and decide whether the eager path
     may safely re-run the op.
 
-    Returns False — the caller must re-raise — only when a buffer donated to
-    the failed call was already invalidated by XLA (replaying would read
-    garbage; the donation contract holds every leaf reference until the call
-    succeeds, so this only happens when a failure strikes *after* dispatch
-    consumed the buffer). Otherwise the failure is counted
+    Returns False — the caller must re-raise — in two cases: a
+    request-lifecycle rejection (``DeadlineExceeded`` / ``Shed``, counted in
+    the scheduler's lifecycle ledger — the signature is healthy, the REQUEST
+    ran out of budget, so there is no quarantine and no replay: executing
+    over-deadline work late is exactly what the deadline prevents), or a
+    buffer donated to the failed call already invalidated by XLA (replaying
+    would read garbage; the donation contract holds every leaf reference
+    until the call succeeds, so this only happens when a failure strikes
+    *after* dispatch consumed the buffer). Otherwise the failure is counted
     (``eager_fallbacks``), recorded in ht.diagnostics with the exception type
     and program label, and the signature is quarantined once it has failed
     :func:`quarantine_threshold` times."""
+    if isinstance(exc, (resilience.DeadlineExceeded, resilience.Shed)):
+        kind = (
+            "deadline_expired"
+            if isinstance(exc, resilience.DeadlineExceeded) else "shed"
+        )
+        _get_scheduler().note_lifecycle(kind, _tenant_or_none())
+        return False
     for buf in donated:
         if isinstance(buf, jax.Array) and buf.is_deleted():
             diagnostics.record_resilience_event(
@@ -1140,7 +1273,7 @@ class Deferred:
 
     __slots__ = ("operation", "fn_kwargs", "operands", "shape", "dtype",
                  "gshape", "split", "comm", "size", "value", "wref", "executed",
-                 "req")
+                 "req", "deadline")
 
     def __init__(self, operation, fn_kwargs, operands, shape, dtype, gshape, split, comm, size):
         self.operation = operation
@@ -1160,6 +1293,12 @@ class Deferred:
         # attributes its force to the request that built it. None when the
         # profiler is off — defer_node never pays for it idle.
         self.req = None
+        # wall-clock deadline captured at defer time (same scoping as req, but
+        # armed independently of the profiler switch): a chain built under
+        # `request(tag, deadline_s=...)` carries its deadline to any later
+        # force, from any thread. None when no deadline was ever armed in the
+        # process — the deadline-off path never reads the contextvar.
+        self.deadline = None
 
     @property
     def ndim(self) -> int:
@@ -1342,6 +1481,25 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
     )
     if profiler._active:
         node.req = profiler.current_request()
+    if profiler._deadline_seen:
+        # one attribute read when no deadline was ever armed; the contextvar
+        # lookup only happens in processes that actually use deadlines
+        dl = profiler.current_deadline()
+        if dl is not None:
+            if time.monotonic() >= dl:
+                # defer-time admission: a request that is ALREADY over
+                # deadline dies at its first op in microseconds instead of
+                # building a graph it will never be allowed to force — under
+                # overload this is what lets workers churn through the
+                # expired backlog fast enough to keep serving feasible work
+                _get_scheduler().note_lifecycle(
+                    "deadline_expired", _tenant_or_none()
+                )
+                raise resilience.DeadlineExceeded(
+                    f"deadline passed before defer of "
+                    f"{_op_label(operation)}"
+                )
+            node.deadline = dl
     return node
 
 
@@ -1404,11 +1562,47 @@ def _force_graph(roots: Tuple[Deferred, ...]) -> None:
     _force_graph_inner(roots)
 
 
+def _roots_deadline(roots) -> Optional[float]:
+    """The earliest wall-clock deadline governing this force: the minimum over
+    the roots' defer-time captures and the ambient request deadline. None —
+    after ONE module-attribute read — in any process that never armed a
+    deadline (the deadline-off parity contract)."""
+    if not profiler._deadline_seen:
+        return None
+    dl = profiler.current_deadline()
+    for r in roots:
+        d = r.deadline
+        if d is not None and (dl is None or d < dl):
+            dl = d
+    return dl
+
+
+def _tenant_or_none() -> Optional[str]:
+    """The ambient request tag for lifecycle accounting, or None outside a
+    request scope (per-tenant attribution is best-effort telemetry)."""
+    return profiler.current_request_tag() if profiler._active else None
+
+
 def _force_graph_inner(roots: Tuple[Deferred, ...]) -> bool:
     """Returns True when this call planned work (executed, or submitted a
     dispatch); False when every root was already forced/in flight."""
+    deadline = _roots_deadline(roots)
+    if deadline is not None and time.monotonic() >= deadline:
+        # admission checkpoint: the deadline has already passed, so planning,
+        # compiling, or dispatching would be pure waste — the reader gets the
+        # typed error NOW and the nodes stay unforced. The rejection CONSUMES
+        # the roots' captured deadlines (the request that owned them has been
+        # told): the data itself is not poisoned, so a later force outside
+        # the expired scope computes these same nodes normally.
+        for r in roots:
+            r.deadline = None
+        _get_scheduler().note_lifecycle("deadline_expired", _tenant_or_none())
+        raise resilience.DeadlineExceeded(
+            f"deadline passed before force admission "
+            f"({_op_label(roots[0].operation)})"
+        )
     if async_dispatch_enabled():
-        return _force_async(roots)
+        return _force_async(roots, deadline)
     # serialized legacy path: settle any dispatch-done futures an earlier
     # async force left behind BEFORE taking the lock (the in-flight executor
     # may need the lock to finish — waiting under it would deadlock), then
@@ -1416,7 +1610,7 @@ def _force_graph_inner(roots: Tuple[Deferred, ...]) -> bool:
     # executor did.
     _settle_pending_nodes(roots)
     with _tlock:
-        return _force_sync_locked(roots)
+        return _force_sync_locked(roots, deadline)
 
 
 def _settle_pending_nodes(roots) -> None:
@@ -1449,7 +1643,7 @@ class _ForcePlan:
     __slots__ = (
         "root", "leaves", "leaf_donatable", "plan", "entry_sig",
         "entry_nodes", "arefs", "out_idxs", "root_idxs", "single", "key",
-        "label", "gshape", "split", "padded", "out_shardings",
+        "label", "gshape", "split", "padded", "out_shardings", "deadline",
     )
 
 
@@ -1712,10 +1906,22 @@ def _plan_replay_eager(pl: _ForcePlan) -> list:
     program's compile/execute fails — the plan's ``leaves`` list holds every
     input reference until the program call succeeds, so the replay always has
     live buffers to read. Interior values are memoised identically to the
-    compiled path."""
+    compiled path.
+
+    The op boundary is the one safe interruption point an eager replay has,
+    so a deadline-bearing plan checks its budget between ops and raises a
+    typed ``DeadlineExceeded`` rather than finishing late — nothing has been
+    memoised at that point, so a later (deadline-free) force can still
+    compute the same nodes. Deadline-off replays pay one ``is not None``."""
     leaves = pl.leaves
+    deadline = pl.deadline
     vals = []
     for operation, fn_kwargs, refs in pl.plan:
+        if deadline is not None and time.monotonic() >= deadline:
+            raise resilience.DeadlineExceeded(
+                f"deadline passed between ops of the eager replay "
+                f"({pl.label}, {len(vals)}/{len(pl.plan)} ops done)"
+            )
         args = [leaves[r[1]] if r[0] == "L" else vals[r[1]] for r in refs]
         vals.append(operation(*args, **fn_kwargs))
     results = []
@@ -1806,16 +2012,27 @@ def _record_force_memory(pl: _ForcePlan, outs) -> None:
     profiler.record_force_memory(live)
 
 
-def _force_sync_locked(roots: Tuple[Deferred, ...]) -> bool:
+def _force_sync_locked(roots: Tuple[Deferred, ...],
+                       deadline: Optional[float] = None) -> bool:
     """The serialized executor: plan, call, and memoise under the lock —
-    today's ``HEAT_TPU_ASYNC_DISPATCH=0`` contract, bit for bit. Returns
+    today's ``HEAT_TPU_ASYNC_DISPATCH=0`` contract, bit for bit (the deadline
+    is carried only for the replay's between-ops checkpoint and the typed
+    re-raise below; with no deadline armed nothing here changes). Returns
     False when there was nothing left to force."""
     pl = _linearise(roots)
     if pl is None:
         return False
+    pl.deadline = deadline
     prog = lookup(pl.key, _plan_builder(pl), label=pl.label)
     if prog is None:
-        outs = _plan_replay_eager(pl)
+        try:
+            outs = _plan_replay_eager(pl)
+        except resilience.DeadlineExceeded:
+            # between-ops expiry in serialized mode: counted like every other
+            # lifecycle rejection (nothing is silently dropped), typed to the
+            # reader
+            _get_scheduler().note_lifecycle("deadline_expired", _tenant_or_none())
+            raise
     else:
         donate_idx = _pick_donations(pl, prog)
         try:
@@ -1837,18 +2054,27 @@ def _force_sync_locked(roots: Tuple[Deferred, ...]) -> bool:
                 # donated dispatch never actually aliased the buffers
                 _tally_donated(pl, donate_idx)
         except Exception as exc:
+            # lifecycle rejections (DeadlineExceeded/Shed) come back False —
+            # typed re-raise, no eager replay, no quarantine
             if not fallback_after_failure(
                 pl.key, prog, exc, donated=[pl.leaves[i] for i in donate_idx]
             ):
                 raise
-            outs = _plan_replay_eager(pl)
+            try:
+                outs = _plan_replay_eager(pl)
+            except resilience.DeadlineExceeded:
+                _get_scheduler().note_lifecycle(
+                    "deadline_expired", _tenant_or_none()
+                )
+                raise
     if profiler._active:
         _record_force_memory(pl, outs)
     _memoise(pl, outs)
     return True
 
 
-def _force_async(roots: Tuple[Deferred, ...]) -> bool:
+def _force_async(roots: Tuple[Deferred, ...],
+                 deadline: Optional[float] = None) -> bool:
     """The async executor: plan under the lock, dispatch outside it.
 
     Under the lock: linearise, look up the program, pick donations, claim the
@@ -1860,12 +2086,20 @@ def _force_async(roots: Tuple[Deferred, ...]) -> bool:
     items batch). Warm-up / unsupported signatures replay op-by-op under the
     lock exactly like the serialized path: below-threshold forces never
     queue. Returns False when every root was already forced or in flight
-    (a lost plan race — nothing planned here), True otherwise."""
+    (a lost plan race — nothing planned here), True otherwise.
+
+    ``deadline`` (already admission-checked by the caller) rides the plan and
+    the queued :class:`~._scheduler.WorkItem`: the pre-dispatch checkpoint in
+    :func:`execute` / the scheduler loop cancels expired work with a typed
+    error, and with ``HEAT_TPU_SHED=1`` infeasible (service-time EWMA past
+    the remaining budget) or queue-full deadline-bearing requests are SHED —
+    their futures fail with ``ht.resilience.Shed`` without executing."""
     sched = _get_scheduler()
     with _tlock:
         pl = _linearise(roots)
         if pl is None:
             return False
+        pl.deadline = deadline
         prog = lookup(pl.key, _plan_builder(pl), label=pl.label)
         if prog is None:
             # warm-up / unsupported / quarantined: the op-by-op replay is the
@@ -1875,7 +2109,14 @@ def _force_async(roots: Tuple[Deferred, ...]) -> bool:
             # lock first (its executor may need the lock to finish), so that
             # shape falls through to the unlocked replay below.
             if not any(isinstance(v, PendingValue) for v in pl.leaves):
-                outs = _plan_replay_eager(pl)
+                try:
+                    outs = _plan_replay_eager(pl)
+                except resilience.DeadlineExceeded:
+                    # the replay's between-ops checkpoint fired: count it and
+                    # deliver the typed error to the reader — nothing was
+                    # memoised, so a later deadline-free force still works
+                    sched.note_lifecycle("deadline_expired", _tenant_or_none())
+                    raise
                 if profiler._active:
                     _record_force_memory(pl, outs)
                 _memoise(pl, outs)
@@ -1903,6 +2144,11 @@ def _force_async(roots: Tuple[Deferred, ...]) -> bool:
         req = profiler.current_request() if profiler._active else None
 
     # ---- lock released: everything below runs concurrently with other plans
+    # tenant for lifecycle-ledger attribution, resolved eagerly only when a
+    # deadline is in play (the only case the ledger's events can fire) so the
+    # per-tenant breakdown matches the totals even for expiries that race
+    # past the scheduler's pop-time check into execute()
+    tenant = _tenant_or_none() if pl.deadline is not None else None
     released = []
 
     def release_once():
@@ -1939,10 +2185,26 @@ def _force_async(roots: Tuple[Deferred, ...]) -> bool:
         # it runs on scheduler threads that must not die to user errors
         donation_happened = True
         try:
+            if pl.deadline is not None and time.monotonic() >= pl.deadline:
+                # pre-dispatch checkpoint (covers the inline path and the
+                # pop-to-execute race the scheduler's own check can miss):
+                # expired work is cancelled, its futures fail typed, and the
+                # buffers release through the fail closure
+                sched.note_lifecycle("deadline_expired", tenant)
+                fail(resilience.DeadlineExceeded(
+                    f"deadline passed before dispatch ({pl.label})"
+                ))
+                return
             if prog is None:
                 # warm-up plan whose leaves were pending at lock time: the
                 # (now-resolved) op-by-op replay is the whole execution
-                complete(tuple(_plan_replay_eager(pl)), False)
+                try:
+                    outs = tuple(_plan_replay_eager(pl))
+                except resilience.DeadlineExceeded as dexc:
+                    sched.note_lifecycle("deadline_expired", tenant)
+                    fail(dexc)
+                    return
+                complete(outs, False)
                 return
             try:
                 with profiler.attributed(req):
@@ -1962,18 +2224,47 @@ def _force_async(roots: Tuple[Deferred, ...]) -> bool:
             except Exception as exc:
                 # a fault (injected or real) inside a queued execution falls
                 # back to the op-by-op replay with no data loss: the plan's
-                # leaves list held every input buffer across the failed call
+                # leaves list held every input buffer across the failed call.
+                # Lifecycle rejections (a real or injected DeadlineExceeded,
+                # a Shed) come back False — typed delivery through the
+                # futures, no replay, no quarantine; the next force of these
+                # nodes retries from a clean slate.
                 if not fallback_after_failure(
                     pl.key, prog, exc,
                     donated=[pl.leaves[i] for i in granted_idx],
                 ):
                     fail(exc)
                     return
-                outs = _plan_replay_eager(pl)
+                try:
+                    outs = _plan_replay_eager(pl)
+                except resilience.DeadlineExceeded as dexc:
+                    sched.note_lifecycle("deadline_expired", tenant)
+                    fail(dexc)
+                    return
                 donation_happened = False
             complete(tuple(outs), donation_happened)
         except BaseException as exc:  # pragma: no cover - belt: waiters must
             fail(exc)                 # never strand on a bookkeeping bug
+
+    if (
+        pl.deadline is not None
+        and _knobs.shed
+        and prog is not None
+        and prog.ewma_s > 0.0
+        and time.monotonic() + prog.ewma_s >= pl.deadline
+    ):
+        # SLO-aware admission control (HEAT_TPU_SHED=1): the per-signature
+        # service-time EWMA says this dispatch cannot finish inside the
+        # remaining budget, so executing it would only steal capacity from
+        # feasible requests — shed it NOW with a typed error (the work was
+        # never attempted; retrying without the deadline is safe)
+        sched.note_lifecycle("shed", tenant)
+        fail(resilience.Shed(
+            f"admission control: estimated service time "
+            f"{prog.ewma_s * 1e3:.2f} ms exceeds the remaining deadline "
+            f"budget ({pl.label})"
+        ))
+        return True
 
     try:
         for i, v in enumerate(pl.leaves):
@@ -2012,16 +2303,28 @@ def _force_async(roots: Tuple[Deferred, ...]) -> bool:
         finally:
             sched.end_inline()
         return True
-    tenant = None
-    if profiler._active:
-        tenant = profiler.current_request_tag()
+    if tenant is None:
+        tenant = _tenant_or_none()
     if tenant is None:
         tenant = f"t{threading.get_ident()}"
     item = _scheduler.WorkItem(
         tenant, execute, req=req, batch_key=batch_key, prog=prog,
-        leaves=pl.leaves, complete=complete, fail=fail,
+        leaves=pl.leaves, complete=complete, fail=fail, deadline=pl.deadline,
     )
     if not _submit_with_backpressure(sched, item):
+        if _knobs.shed and pl.deadline is not None:
+            # load-shedding backpressure: a queue that stayed full through
+            # the whole retry ladder means the system is past capacity — a
+            # deadline-bearing request is shed with a typed error instead of
+            # executing inline (inline execution under overload is exactly
+            # the everyone-serialises collapse shedding exists to prevent).
+            # Deadline-free work still runs inline: never silently dropped.
+            sched.note_lifecycle("shed", tenant)
+            fail(resilience.Shed(
+                f"dispatch queue full through backpressure; shedding "
+                f"deadline-bearing request ({pl.label})"
+            ))
+            return True
         # the queue stayed full through the backpressure policy: run inline —
         # slower than queued+batched, but work is never dropped
         execute()
@@ -2074,10 +2377,19 @@ _QUEUE_POLICY = resilience.Policy(
 
 def _submit_with_backpressure(sched, item) -> bool:
     """Submit ``item``; a full queue retries under the ``executor.queue``
-    resilience policy. False means the caller should execute inline."""
+    resilience policy. False means the caller should execute inline (or, in
+    shed mode with a deadline, shed). A draining scheduler refuses admission
+    immediately — no point burning the backoff ladder on a queue that will
+    not re-open."""
     bound = queue_bound()
     if sched.submit(item, bound):
         return True
+    if sched.draining():
+        if diagnostics._enabled:
+            diagnostics.record_fallback(
+                "executor.queue", "scheduler draining; admission closed"
+            )
+        return False
 
     def attempt():
         if not sched.submit(item, bound):
@@ -2102,3 +2414,25 @@ def _submit_with_backpressure(sched, item) -> bool:
 # ten hottest signatures (registered as a provider so diagnostics stays
 # standalone-loadable — no import cycle).
 diagnostics.register_provider("executor", lambda: executor_stats(top=10))
+
+
+# Interpreter-shutdown drain: a force blocked on a PendingValue whose queued
+# item never executes (scheduler daemon thread killed mid-queue, a test that
+# left the scheduler paused, an atexit hook reading a deferred value) would
+# otherwise hang forever. The drain flushes what it can within its timeout
+# and sheds the rest with typed errors — every outstanding future is settled
+# either way. Registered only by the package instance (the standalone
+# file-path loads never build a scheduler), and registered AT IMPORT so user
+# atexit hooks (registered later, run earlier under LIFO) still see a live
+# scheduler while the drain runs after them.
+if __package__:
+
+    @atexit.register
+    def _drain_scheduler_at_exit() -> None:  # pragma: no cover - exit hook
+        sched = _dispatch_scheduler
+        if sched is None:
+            return
+        try:
+            sched.drain(timeout=5.0)
+        except Exception:  # ht: ignore[silent-except] -- atexit hook: the drain already delivered typed errors to every leftover future; raising here would mask the process's real exit status
+            pass

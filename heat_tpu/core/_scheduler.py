@@ -38,12 +38,32 @@ until the program *dispatch* returns (jax arrays are themselves asynchronous —
 device execution continues in the background), so a ``.parray`` read overlaps
 host-side graph building of other requests with device work.
 
+**Request lifecycle (ISSUE 10).** A :class:`WorkItem` carries the request's
+wall-clock ``deadline`` (an absolute ``time.monotonic()`` instant, captured by
+the executor from the profiler's request scope / the deferred nodes), and the
+scheduler acts on it at the two checkpoints it owns: **pre-dispatch** — an
+expired item popped by the drain loop is cancelled instead of executed, its
+futures failed with a typed ``ht.resilience.DeadlineExceeded`` (which releases
+its buffer ownership through the item's ``fail`` closure) — and **batch
+formation** — expired peers are pulled out of the batch-key index and
+cancelled rather than widening a healthy batch. Explicit lifecycle verbs:
+:meth:`DispatchScheduler.cancel` fails a tenant's queued items with
+``RequestCancelled``; :meth:`DispatchScheduler.drain` stops admission, flushes
+(or, past its timeout, sheds with a raised-and-delivered ``DrainTimeout``)
+everything outstanding so no ``PendingValue`` can stay blocked forever — the
+executor registers an atexit drain for interpreter shutdown;
+:meth:`DispatchScheduler.reopen` re-opens admission after a drain.
+
 Telemetry (surfaced through ``ht.executor_stats()`` and mirrored as
 ``ht.diagnostics`` counters by the executor): ``queue_depth_peak``,
 ``batched_requests`` (requests that rode a batched execution),
-``batch_width_hist`` (batch width -> count), plus submit/inline tallies.  When
-the profiler is active every enqueue/dequeue records a ``queue_depth`` counter
-sample, exported as a Perfetto counter track.
+``batch_width_hist`` (batch width -> count), submit/inline tallies, and the
+lifecycle ledger ``lifecycle`` (``deadline_expired`` / ``shed`` /
+``cancelled`` totals, also per tenant) — every shed/cancel/expiry is counted,
+nothing is silently dropped.  When the profiler is active every
+enqueue/dequeue records a ``queue_depth`` counter sample, exported as a
+Perfetto counter track, and every lifecycle event samples a
+``lifecycle.<kind>`` cumulative counter track.
 
 Stdlib-only at module load (the executor imports it lazily-cheap); all jax
 work lives in the closures the executor puts on the items.
@@ -53,10 +73,20 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from collections import OrderedDict, deque
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
+
+try:  # standalone file-path load (driver entry points): no parent package —
+    from . import resilience  # the lifecycle verbs are never used in that mode
+except ImportError:  # pragma: no cover - exercised via tests/test_analysis.py
+    resilience = None
 
 __all__ = ["PendingValue", "WorkItem", "DispatchScheduler"]
+
+#: the lifecycle ledger's keys — one per typed rejection the executor/scheduler
+#: can deliver instead of a result (see ``ht.resilience``)
+LIFECYCLE_KINDS = ("deadline_expired", "shed", "cancelled")
 
 
 class PendingValue:
@@ -122,12 +152,12 @@ class WorkItem:
 
     __slots__ = (
         "seq", "tenant", "req", "execute", "batch_key", "prog", "leaves",
-        "complete", "fail",
+        "complete", "fail", "deadline",
     )
 
     def __init__(self, tenant: str, execute: Callable[[], None], *,
                  req=None, batch_key=None, prog=None, leaves=None,
-                 complete=None, fail=None):
+                 complete=None, fail=None, deadline: Optional[float] = None):
         self.seq = 0  # assigned by the scheduler at submit
         self.tenant = tenant
         self.req = req
@@ -137,6 +167,16 @@ class WorkItem:
         self.leaves = leaves
         self.complete = complete
         self.fail = fail
+        # absolute wall-clock deadline (time.monotonic() instant) or None:
+        # the scheduler cancels rather than executes an item past it
+        self.deadline = deadline
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+    def describe(self) -> str:
+        label = getattr(self.prog, "label", None) or "eager-replay"
+        return f"{self.tenant}#{self.seq}:{label}"
 
 
 def _bucket_width(n: int, cap: int) -> int:
@@ -167,6 +207,7 @@ class DispatchScheduler:
         self._depth = 0
         self._active = 0          # executions in flight (inline + thread)
         self._paused = False      # test hook: hold items in the queue
+        self._draining = False    # lifecycle: admission closed (drain/shutdown)
         self._seq = itertools.count(1)
         self._thread: Optional[threading.Thread] = None
         self.batch_runner = batch_runner
@@ -177,6 +218,11 @@ class DispatchScheduler:
         self.submitted = 0
         self.inline_runs = 0
         self.queue_full_events = 0
+        self.drain_rejects = 0    # submits refused because admission is closed
+        # the lifecycle ledger: every request-shaped rejection is counted here
+        # (totals + per tenant) so nothing is ever silently dropped
+        self.lifecycle: Dict[str, int] = {k: 0 for k in LIFECYCLE_KINDS}
+        self.tenant_lifecycle: Dict[str, Dict[str, int]] = {}
 
     # ------------------------------------------------------------- submission
     def try_inline(self) -> bool:
@@ -198,9 +244,13 @@ class DispatchScheduler:
 
     def submit(self, item: WorkItem, bound: int) -> bool:
         """Park ``item`` in its tenant's queue. False when the queue is at
-        ``bound`` — the caller applies its backpressure policy and retries or
-        executes inline."""
+        ``bound`` (the caller applies its backpressure policy and retries or
+        executes inline) or when the scheduler is draining (admission closed:
+        the caller executes inline or sheds — work is never dropped)."""
         with self._cv:
+            if self._draining:
+                self.drain_rejects += 1
+                return False
             if self._depth >= bound:
                 self.queue_full_events += 1
                 return False
@@ -247,9 +297,22 @@ class DispatchScheduler:
             if not peers:
                 del self._by_key[item.batch_key]
 
-    def _pop_group_locked(self, batch_cap: int) -> List[WorkItem]:
-        """Round-robin tenant pop + cross-tenant batch collection. Under _cv."""
-        item: Optional[WorkItem] = None
+    def _remove_item_locked(self, item: WorkItem) -> None:
+        """Pull a still-queued ``item`` out of its tenant deque + the batch
+        index and account the depth change. Under _cv."""
+        q = self._queues.get(item.tenant)
+        if q is not None:
+            try:
+                q.remove(item)
+            except ValueError:
+                return  # already popped by a racing path
+            if not q:
+                del self._queues[item.tenant]
+        self._unindex_locked(item)
+        self._depth -= 1
+
+    def _pop_one_locked(self) -> Optional[WorkItem]:
+        """Round-robin pop of one item across tenant deques. Under _cv."""
         for tenant in list(self._queues):
             q = self._queues[tenant]
             if q:
@@ -257,27 +320,101 @@ class DispatchScheduler:
                 self._queues.move_to_end(tenant)  # fairness: rotate the tenant
                 if not q:
                     del self._queues[tenant]
-                break
-        if item is None:
-            return []
-        self._unindex_locked(item)
+                self._unindex_locked(item)
+                self._depth -= 1
+                return item
+        return None
+
+    def _pop_group_locked(
+        self, batch_cap: int, now: float
+    ) -> Tuple[List[WorkItem], List[WorkItem]]:
+        """Round-robin tenant pop + cross-tenant batch collection, with the
+        pre-dispatch deadline checkpoint: items whose deadline has passed are
+        pulled out and returned separately (``expired``) instead of being
+        executed or widening the batch — the caller fails their futures
+        OUTSIDE the lock. Under _cv."""
+        expired: List[WorkItem] = []
+        item: Optional[WorkItem] = None
+        while True:
+            item = self._pop_one_locked()
+            if item is None:
+                return [], expired
+            if item.expired(now):
+                expired.append(item)
+                continue
+            break
         group = [item]
         if item.batch_key is not None and batch_cap > 1:
             # gather same-signature items from EVERY tenant queue (this is the
             # cross-request half of signature batching) via the batch-key
-            # index, oldest first — no full-queue scan under the lock
-            matches = list(self._by_key.get(item.batch_key, ()))
-            matches.sort(key=lambda w: w.seq)
-            width = _bucket_width(1 + len(matches), batch_cap)
-            take = matches[: width - 1]
+            # index, oldest first — no full-queue scan under the lock. Expired
+            # peers are cancelled here rather than batched: over-deadline work
+            # must not widen (or slow) a healthy batch.
+            matches = sorted(self._by_key.get(item.batch_key, ()), key=lambda w: w.seq)
+            live: List[WorkItem] = []
+            for w in matches:
+                if w.expired(now):
+                    self._remove_item_locked(w)
+                    expired.append(w)
+                else:
+                    live.append(w)
+            width = _bucket_width(1 + len(live), batch_cap)
+            take = live[: width - 1]
             for w in take:
-                self._queues[w.tenant].remove(w)
-                self._unindex_locked(w)
-                if not self._queues[w.tenant]:
-                    del self._queues[w.tenant]
+                self._remove_item_locked(w)
             group.extend(take)
-        self._depth -= len(group)
-        return group
+        return group, expired
+
+    def _count_lifecycle_locked(self, kind: str, tenant: Optional[str],
+                                n: int = 1) -> int:
+        """Account ``n`` lifecycle events of ``kind``; returns the new total
+        (the cumulative value behind the profiler counter track). Under _cv."""
+        self.lifecycle[kind] += n
+        if tenant is not None:
+            per = self.tenant_lifecycle.get(tenant)
+            if per is None:
+                per = self.tenant_lifecycle[tenant] = {
+                    k: 0 for k in LIFECYCLE_KINDS
+                }
+            per[kind] += n
+        return self.lifecycle[kind]
+
+    def note_lifecycle(self, kind: str, tenant: Optional[str] = None,
+                       n: int = 1) -> None:
+        """Count ``n`` shed/cancelled/expired requests (the executor's
+        admission-side events route here too, so ``executor_stats()`` has ONE
+        ledger) and mirror them to diagnostics counters and the profiler's
+        cumulative ``lifecycle.<kind>`` counter track."""
+        with self._cv:
+            total = self._count_lifecycle_locked(kind, tenant, n)
+        from . import diagnostics, profiler
+
+        if diagnostics._enabled:
+            diagnostics.counter(f"executor.{kind}", n)
+        if profiler._active:
+            profiler.record_counter(f"lifecycle.{kind}", total)
+
+    def _deliver_lifecycle(self, item: WorkItem, kind: str,
+                           exc: BaseException) -> None:
+        """Fail a cancelled/expired/shed item's futures with the typed error
+        (releasing its buffer ownership through the ``fail`` closure) and
+        mirror the already-ledgered event to diagnostics + the profiler
+        counter track. Never raises — this runs on the scheduler thread and
+        in drain paths. The ledger increment itself happens under _cv at the
+        site that pulled the item out of the queue."""
+        try:
+            if item.fail is not None:
+                item.fail(exc)
+        except BaseException:  # pragma: no cover - belt: a bookkeeping bug in
+            pass               # one item must not strand the rest
+        from . import diagnostics, profiler
+
+        if diagnostics._enabled:
+            diagnostics.counter(f"executor.{kind}", 1)
+        if profiler._active:
+            # cumulative sample; the bare read of the ledger is a relaxed
+            # telemetry snapshot, not a synchronised count
+            profiler.record_counter(f"lifecycle.{kind}", self.lifecycle[kind])
 
     def _loop(self) -> None:
         from . import _executor  # late: the executor imports this module first
@@ -286,18 +423,35 @@ class DispatchScheduler:
             with self._cv:
                 while self._depth == 0 or self._paused:
                     self._cv.wait()
-                group = self._pop_group_locked(_executor.batch_max())
-                if not group:
-                    continue
-                self._active += 1
-                if len(group) > 1:
-                    width = len(group)
-                    self.batched_requests += width
-                    self.batch_width_hist[width] = (
-                        self.batch_width_hist.get(width, 0) + 1
-                    )
+                group, expired = self._pop_group_locked(
+                    _executor.batch_max(), time.monotonic()
+                )
+                if expired:
+                    for w in expired:
+                        self._count_lifecycle_locked("deadline_expired", w.tenant)
+                if group:
+                    self._active += 1
+                    if len(group) > 1:
+                        width = len(group)
+                        self.batched_requests += width
+                        self.batch_width_hist[width] = (
+                            self.batch_width_hist.get(width, 0) + 1
+                        )
+                else:
+                    # everything popped this round had expired: wake wait_idle
+                    # / drain waiters watching the depth we just lowered
+                    self._cv.notify_all()
                 depth = self._depth
             self._note_depth(depth)
+            for w in expired:
+                self._deliver_lifecycle(
+                    w, "deadline_expired",
+                    resilience.DeadlineExceeded(
+                        f"deadline passed while queued ({w.describe()})"
+                    ),
+                )
+            if not group:
+                continue
             try:
                 if len(group) == 1:
                     group[0].execute()
@@ -316,6 +470,92 @@ class DispatchScheduler:
                     self._active -= 1
                     self._cv.notify_all()
 
+    # ------------------------------------------------------------- lifecycle
+    def cancel(self, tag: str) -> int:
+        """Cancel every still-queued item of tenant ``tag``: the items never
+        execute, their futures are failed with a typed
+        ``ht.resilience.RequestCancelled`` (releasing their buffer ownership),
+        and the cancellations land in the lifecycle ledger. In-flight
+        executions are not interrupted (a dispatched XLA call is not safely
+        interruptible); their futures are fulfilled normally. Returns the
+        number of items cancelled."""
+        with self._cv:
+            q = self._queues.pop(tag, None)
+            items = list(q) if q else []
+            for w in items:
+                self._unindex_locked(w)
+            self._depth -= len(items)
+            for w in items:
+                self._count_lifecycle_locked("cancelled", w.tenant)
+            if items:
+                self._cv.notify_all()
+        for w in items:
+            self._deliver_lifecycle(
+                w, "cancelled",
+                resilience.RequestCancelled(
+                    f"cancelled by DispatchScheduler.cancel({tag!r}) "
+                    f"before dispatch ({w.describe()})"
+                ),
+            )
+        return len(items)
+
+    def drain(self, timeout: float = 30.0) -> dict:
+        """Stop admitting, flush the queue, and guarantee every outstanding
+        future is fulfilled with a value or a typed error.
+
+        Admission closes immediately (``submit`` returns False — submitters
+        execute inline or shed, so new work is never dropped) and any test
+        ``pause`` is lifted so the drain thread can run. Then this call waits
+        up to ``timeout`` seconds for the queue to empty and in-flight
+        executions to finish. On success returns ``{"flushed": n, ...}``
+        quietly; on timeout every still-queued item is SHED — its futures are
+        failed with the same typed :class:`~.resilience.DrainTimeout` that is
+        then raised to the caller, naming the undelivered futures — so a
+        timed-out drain can never leave a ``PendingValue`` blocked forever.
+        Executions still in flight at the timeout are named in the error too;
+        their futures are fulfilled by the executing thread when it finishes.
+
+        The scheduler stays closed to admission afterwards (shutdown is the
+        expected caller); use :meth:`reopen` to resume normal service."""
+        with self._cv:
+            self._draining = True
+            self._paused = False
+            self._cv.notify_all()
+            flushed = self._cv.wait_for(
+                lambda: self._depth == 0 and self._active == 0,
+                timeout=max(0.0, timeout),
+            )
+            leftovers: List[WorkItem] = []
+            still_active = self._active
+            if not flushed:
+                while True:
+                    item = self._pop_one_locked()
+                    if item is None:
+                        break
+                    leftovers.append(item)
+                for w in leftovers:
+                    self._count_lifecycle_locked("shed", w.tenant)
+                if leftovers:
+                    self._cv.notify_all()
+        if flushed:
+            return {"flushed": True, "shed": 0, "in_flight": 0}
+        exc = resilience.DrainTimeout(
+            timeout, [w.describe() for w in leftovers], still_active
+        )
+        for w in leftovers:
+            self._deliver_lifecycle(w, "shed", exc)
+        raise exc
+
+    def reopen(self) -> None:
+        """Re-open admission after a :meth:`drain` (tests, rolling restarts)."""
+        with self._cv:
+            self._draining = False
+            self._cv.notify_all()
+
+    def draining(self) -> bool:
+        with self._cv:
+            return self._draining
+
     # ------------------------------------------------------------- telemetry
     def _note_depth(self, depth: int) -> None:
         from . import profiler
@@ -333,6 +573,12 @@ class DispatchScheduler:
                 "submitted": self.submitted,
                 "inline_runs": self.inline_runs,
                 "queue_full_events": self.queue_full_events,
+                "drain_rejects": self.drain_rejects,
+                "draining": self._draining,
+                "lifecycle": dict(self.lifecycle),
+                "tenant_lifecycle": {
+                    t: dict(per) for t, per in self.tenant_lifecycle.items()
+                },
             }
 
     def reset_stats(self) -> None:
@@ -343,6 +589,9 @@ class DispatchScheduler:
             self.submitted = 0
             self.inline_runs = 0
             self.queue_full_events = 0
+            self.drain_rejects = 0
+            self.lifecycle = {k: 0 for k in LIFECYCLE_KINDS}
+            self.tenant_lifecycle = {}
 
     # -------------------------------------------------------------- test hooks
     def pause(self) -> None:

@@ -47,6 +47,18 @@ compiled HLO is byte-identical with the profiler enabled, disabled, or never
 touched (``tests/test_profiler.py::TestHLOParity``), and the dispatch ops/s
 baseline gates keep enforcing the idle cost in CI.
 
+Request deadlines (ISSUE 10)
+----------------------------
+``request(tag, deadline_s=...)`` additionally arms a wall-clock deadline in a
+second contextvar scoped exactly like the request id: ``current_deadline()``
+reads it, ``Deferred`` nodes capture it at defer time, and the async
+executor's lifecycle checkpoints (admission, pre-dispatch, batch formation,
+eager replay) act on it. Deadlines are a lifecycle contract rather than
+telemetry, so they are armed even while the profiler is disabled; the
+``_deadline_seen`` module attribute (set once, never cleared, deliberately
+relaxed like ``_active``) lets a process that never uses deadlines skip even
+the contextvar read.
+
 Thread-safety
 -------------
 All registries mutate under one module lock; the current request id is a
@@ -94,6 +106,7 @@ __all__ = [
     "request",
     "current_request",
     "current_request_tag",
+    "current_deadline",
     "attributed",
     "scope",
     "observe",
@@ -134,6 +147,19 @@ _rid_counter = itertools.count(1)
 _current_request: "contextvars.ContextVar[Optional[int]]" = contextvars.ContextVar(
     "heat_tpu_profiler_request", default=None
 )
+
+# Request deadlines ride the same contextvar scoping as the request id but are
+# a LIFECYCLE feature, not telemetry: `request(tag, deadline_s=...)` arms the
+# ambient deadline even while the profiler is disabled, and the executor's
+# deadline checkpoints act on it either way. `_deadline_seen` is the relaxed
+# one-attribute-read gate (set once, never cleared) the executor's hot paths
+# check before paying the contextvar lookup — a process that never sets a
+# deadline never reads the contextvar at all (the deadline-off parity
+# contract the dispatch ops/s baseline gates enforce).
+_current_deadline: "contextvars.ContextVar[Optional[float]]" = contextvars.ContextVar(
+    "heat_tpu_profiler_deadline", default=None
+)
+_deadline_seen: bool = False
 
 # perf_counter origin for trace timestamps; rebased on enable() so a long-lived
 # process's trace starts near zero. Microseconds, Chrome's native unit.
@@ -350,15 +376,40 @@ def attributed(req: Optional[int]):
         _current_request.reset(token)
 
 
+def current_deadline() -> Optional[float]:
+    """The ambient request's absolute wall-clock deadline (a
+    ``time.monotonic()`` instant), or None when no deadline is armed. Set by
+    ``request(tag, deadline_s=...)``; captured by ``Deferred`` nodes at defer
+    time and acted on at the executor's lifecycle checkpoints."""
+    return _current_deadline.get()
+
+
 @contextlib.contextmanager
-def request(tag: str):
+def request(tag: str, deadline_s: Optional[float] = None):
     """Scope one serving request: allocates a request id, makes it the ambient
     request for every profiler hook on this thread (dispatch, force, program
     call, collective), records the request as a top-level slice on its own
     trace track, and observes its wall latency into the ``request.<tag>``
-    histogram. Yields the request id. No-op (yields None) while disabled."""
+    histogram. Yields the request id. No-op (yields None) while disabled.
+
+    ``deadline_s`` arms a wall-clock deadline ``deadline_s`` seconds from now
+    for everything scoped under this request — deferred nodes capture it at
+    defer time (like the request id), the async executor refuses/cancels work
+    that cannot meet it, and readers get a typed
+    ``ht.resilience.DeadlineExceeded`` instead of late results. The deadline
+    is a lifecycle contract, not telemetry: it is armed even while the
+    profiler is disabled."""
+    global _deadline_seen
+    dtoken = None
+    if deadline_s is not None:
+        _deadline_seen = True
+        dtoken = _current_deadline.set(time.monotonic() + float(deadline_s))
     if not _active:
-        yield None
+        try:
+            yield None
+        finally:
+            if dtoken is not None:
+                _current_deadline.reset(dtoken)
         return
     rid = next(_rid_counter)
     t0 = _now_us()
@@ -371,6 +422,8 @@ def request(tag: str):
         yield rid
     finally:
         _current_request.reset(token)
+        if dtoken is not None:
+            _current_deadline.reset(dtoken)
         t1 = _now_us()
         with _lock:
             entry = _requests.get(rid)

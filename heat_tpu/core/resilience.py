@@ -41,6 +41,18 @@ backpressure; the executor's built-in default is a few-millisecond ladder)
 and, exhausted, executes inline — retries and exhaustions land in the
 resilience event stream like every other site's.
 
+This module also defines the **request-lifecycle** error vocabulary the
+executor's deadline/cancellation/shedding machinery (ISSUE 10) delivers
+through dispatch-done futures: :class:`DeadlineExceeded` (the request's
+wall-clock deadline passed — never retried by a :class:`Policy`),
+:class:`Shed` (``HEAT_TPU_SHED=1`` admission control rejected infeasible or
+queue-full work without attempting it), :class:`RequestCancelled`
+(``DispatchScheduler.cancel(tag)``), and :class:`DrainTimeout`
+(``DispatchScheduler.drain(timeout)`` could not flush — raised to the caller
+AND delivered to every still-queued future so nothing blocks forever). The
+``deadline-exceeded`` fault kind injects :class:`InjectedDeadlineExceeded`
+so chaos plans can fire expiries inside queued and batched executions.
+
 Zero-cost contract (same discipline as ``ht.diagnostics`` and
 ``HEAT_TPU_TRACE``): instrumented sites gate on the module attributes
 ``resilience._armed`` (a fault plan is loaded) / ``resilience._active``
@@ -96,7 +108,12 @@ __all__ = [
     "FaultInjected",
     "InjectedTimeout",
     "InjectedBackendDown",
+    "InjectedDeadlineExceeded",
     "CircuitOpen",
+    "DeadlineExceeded",
+    "Shed",
+    "RequestCancelled",
+    "DrainTimeout",
 ]
 
 # Hot-path gates, read as ``resilience._armed`` / ``resilience._active`` by the
@@ -108,7 +125,7 @@ _active: bool = False
 
 _lock = threading.RLock()
 
-FAULT_KINDS = ("raise", "timeout", "backend-down", "torn-write")
+FAULT_KINDS = ("raise", "timeout", "backend-down", "torn-write", "deadline-exceeded")
 
 
 class FaultInjected(RuntimeError):
@@ -132,6 +149,53 @@ class CircuitOpen(RuntimeError):
     def __init__(self, site: str):
         super().__init__(f"circuit breaker for site {site!r} is open")
         self.site = site
+
+
+# ------------------------------------------------------------- request lifecycle
+class DeadlineExceeded(RuntimeError):
+    """A request's wall-clock deadline passed before (or while) its work could
+    run: the executor delivers this instead of late results — at queue
+    admission, when an expired queued item is cancelled pre-dispatch, and
+    between ops of an eager replay. Never retried by a :class:`Policy`
+    (retrying cannot un-expire a deadline)."""
+
+
+class Shed(RuntimeError):
+    """The load-shedding admission control (``HEAT_TPU_SHED=1``) rejected this
+    request instead of executing it: its deadline was infeasible per the
+    per-signature service-time estimate, or the dispatch queue stayed full
+    through backpressure. The work was NOT attempted — retrying later (or
+    without a deadline) is safe and side-effect-free."""
+
+
+class RequestCancelled(RuntimeError):
+    """Queued work was cancelled by an explicit lifecycle verb
+    (``DispatchScheduler.cancel(tag)``) before it dispatched."""
+
+
+class DrainTimeout(RuntimeError):
+    """``DispatchScheduler.drain(timeout)`` could not flush the queue in time.
+    Every still-queued item's future was failed with this same exception (so
+    nothing is left blocked); ``undelivered`` names them, and ``in_flight``
+    counts executions that were still running when the timeout struck (their
+    futures are fulfilled by the executing thread when it finishes)."""
+
+    def __init__(self, timeout_s: float, undelivered, in_flight: int = 0):
+        self.timeout_s = timeout_s
+        self.undelivered = list(undelivered)
+        self.in_flight = int(in_flight)
+        names = ", ".join(self.undelivered) or "<none>"
+        super().__init__(
+            f"scheduler drain did not settle within {timeout_s:.3f}s: "
+            f"{len(self.undelivered)} queued item(s) shed with this error "
+            f"({names}); {self.in_flight} execution(s) still in flight"
+        )
+
+
+class InjectedDeadlineExceeded(FaultInjected, DeadlineExceeded):
+    """Injected ``deadline-exceeded`` fault — also a :class:`DeadlineExceeded`
+    so the executor's lifecycle paths (typed delivery, no eager replay of
+    over-deadline work, no quarantine) treat it exactly like a real expiry."""
 
 
 def _record_event(site: str, kind: str, detail: str = "") -> None:
@@ -223,6 +287,16 @@ class Policy:
                 result = fn(*args, **kwargs)
             except self.retry_on as exc:
                 if isinstance(exc, CircuitOpen):
+                    raise
+                if isinstance(exc, DeadlineExceeded):
+                    # a deadline that has passed cannot un-pass: retrying would
+                    # only burn backoff time the request no longer has. The
+                    # breaker learned NOTHING about the backend from a request
+                    # running out of time — release a half-open probe token so
+                    # the next caller can run the real trial instead of
+                    # everyone waiting out another cooldown.
+                    if breaker is not None:
+                        breaker.abandon_probe()
                     raise
                 if breaker is not None:
                     breaker.record_failure(f"{type(exc).__name__}: {exc}")
@@ -329,10 +403,14 @@ class CircuitBreaker:
     ``failure_threshold`` consecutive :meth:`record_failure` calls open the
     circuit; :meth:`allows` then returns False (callers short-circuit to their
     cached negative result) until ``cooldown_s`` elapses, when the breaker
-    half-opens: the next call is allowed as a trial — success closes the
-    circuit, failure re-opens it (restarting the cooldown). Half-open does not
-    serialise concurrent trials; the probe sites that use breakers are already
-    serialised by their own locks/subprocess structure.
+    half-opens: exactly ONE caller per half-open window is admitted as the
+    trial probe — success closes the circuit, failure re-opens it (restarting
+    the cooldown). Concurrent callers during the trial see the circuit as
+    still open, so N threads hitting a half-open breaker cannot re-probe a
+    down backend simultaneously (the thundering-herd shape the relay probe's
+    90 s subprocess timeout makes expensive). A probe holder that never
+    reports back (crashed caller) forfeits its token after another
+    ``cooldown_s``, when a fresh window grants a new one.
 
     Every state transition is recorded via
     ``diagnostics.record_resilience_event(site, "breaker", "old->new")``.
@@ -342,6 +420,7 @@ class CircuitBreaker:
     __slots__ = (
         "site", "failure_threshold", "cooldown_s", "clock",
         "_state", "_failures", "_opened_at", "opens", "short_circuits",
+        "_probe_taken", "_probe_at",
     )
 
     def __init__(
@@ -362,10 +441,14 @@ class CircuitBreaker:
         self._opened_at: Optional[float] = None
         self.opens = 0
         self.short_circuits = 0
+        self._probe_taken = False
+        self._probe_at: Optional[float] = None
 
     def _transition(self, new: str, detail: str = "") -> None:
         old, self._state = self._state, new
         if old != new:
+            self._probe_taken = False  # every state change opens a fresh window
+            self._probe_at = None
             _record_event(
                 self.site, "breaker", f"{old}->{new}" + (f": {detail}" if detail else "")
             )
@@ -383,15 +466,40 @@ class CircuitBreaker:
             return self._state
 
     def allows(self) -> bool:
-        """Whether a call may proceed: True in closed and half-open (the trial),
-        False while open (the caller should use its cached negative result)."""
+        """Whether a call may proceed: True in closed; in half-open True for
+        exactly ONE caller per window (the trial probe — everyone else sees the
+        circuit as open until the probe reports); False while open (the caller
+        should use its cached negative result)."""
         with _lock:
             self._poll()
+            if self._state == HALF_OPEN:
+                if self._probe_taken and self._probe_at is not None and (
+                    self.clock() - self._probe_at < self.cooldown_s
+                ):
+                    # a trial probe is already out: admitting more would
+                    # thundering-herd the backend the breaker is protecting
+                    self.short_circuits += 1
+                    _count(f"resilience.breaker.{self.site}.short_circuit")
+                    return False
+                # first caller of this window (or the previous probe holder
+                # vanished for a whole cooldown): this call IS the trial
+                self._probe_taken = True
+                self._probe_at = self.clock()
+                return True
             if self._state == OPEN:
                 self.short_circuits += 1
                 _count(f"resilience.breaker.{self.site}.short_circuit")
                 return False
             return True
+
+    def abandon_probe(self) -> None:
+        """Release a held half-open probe token WITHOUT a verdict: the trial
+        call ended for a reason that says nothing about the backend (its
+        request's deadline expired). The next caller becomes the trial."""
+        with _lock:
+            if self._state == HALF_OPEN:
+                self._probe_taken = False
+                self._probe_at = None
 
     def record_success(self) -> None:
         with _lock:
@@ -421,6 +529,7 @@ class CircuitBreaker:
                 "cooldown_s": self.cooldown_s,
                 "opens": self.opens,
                 "short_circuits": self.short_circuits,
+                "half_open_probe_out": self._probe_taken and self._state == HALF_OPEN,
             }
 
 
@@ -614,6 +723,8 @@ def raise_entry(entry: _FaultEntry, site: str) -> None:
         raise InjectedTimeout(msg)
     if entry.kind == "backend-down":
         raise InjectedBackendDown(msg)
+    if entry.kind == "deadline-exceeded":
+        raise InjectedDeadlineExceeded(msg)
     raise FaultInjected(msg)
 
 

@@ -873,7 +873,9 @@ class TestAsyncExecutor(TestCase):
         sched = _executor._dispatch_scheduler
         if sched is not None:
             sched.resume()
-            sched.wait_idle(30.0)
+            # wait_idle returns False on timeout — ignoring it would let a
+            # stuck scheduler silently poison every later test
+            self.assertTrue(sched.wait_idle(30.0), "scheduler stuck busy")
         super().tearDown()
 
     def _queue_forces(self, thunks, min_depth):
@@ -1232,3 +1234,351 @@ class TestAsyncFailureDelivery(TestCase):
             np.testing.assert_allclose(
                 z.numpy(), np_a * 2.0 + 1.0, rtol=1e-6, atol=1e-6
             )
+
+
+# ----------------------------------------------------- request lifecycle (ISSUE 10)
+class TestRequestLifecycle(TestCase):
+    """Deadlines, cooperative cancellation, SLO-aware shedding, and drain:
+    every rejected request gets a TYPED ``ht.resilience`` error (never a hang,
+    never a silent full execution), every rejection lands in the lifecycle
+    ledger, and the scheduler's drain/reopen verbs leave no future stranded."""
+
+    def setUp(self):
+        super().setUp()
+        from heat_tpu.core import profiler
+
+        sched = _executor._dispatch_scheduler
+        if sched is not None:
+            sched.reopen()
+            sched.resume()
+            self.assertTrue(sched.wait_idle(30.0), "scheduler stuck busy")
+        _executor.clear_executor_cache()
+        profiler.enable()
+        self.addCleanup(profiler.disable)
+        self.addCleanup(profiler.reset)
+
+    def tearDown(self):
+        sched = _executor._dispatch_scheduler
+        if sched is not None:
+            sched.reopen()
+            sched.resume()
+            self.assertTrue(sched.wait_idle(30.0), "scheduler stuck busy")
+        super().tearDown()
+
+    def _resilience(self):
+        from heat_tpu.core import resilience
+
+        return resilience
+
+    def _force_under_request(self, tag, deadline_s, np_a, outcomes,
+                            scalar=2.0):
+        """Build + force one deferred chain inside a request scope on the
+        calling thread; record ("ok", bits) or ("err", exc) into outcomes."""
+        from heat_tpu.core import profiler
+
+        with profiler.request(tag, deadline_s=deadline_s):
+            try:
+                x = ht.array(np_a, split=0)
+                v = (x + 1.0) * scalar
+                outcomes[tag] = ("ok", v.numpy())
+            except BaseException as exc:
+                outcomes[tag] = ("err", exc)
+
+    def test_admission_expired_is_typed_and_plans_nothing(self):
+        from heat_tpu.core import profiler
+
+        resilience = self._resilience()
+        np_a, _ = _np_pair(_RAGGED)
+        with profiler.request("adm", deadline_s=0.2):
+            x = ht.array(np_a, split=0)
+            z = (x + 1.0) * 2.0
+        time.sleep(0.3)  # the captured deadline expires before the force
+        before = ht.executor_stats()
+        with self.assertRaises(resilience.DeadlineExceeded):
+            z.parray
+        after = ht.executor_stats()
+        # rejected AT ADMISSION: no plan, no lookup, no compile
+        self.assertEqual(after["misses"], before["misses"])
+        self.assertEqual(after["retraces"], before["retraces"])
+        self.assertGreater(after["expired_requests"], before["expired_requests"])
+        # the rejection CONSUMED the captured deadline: the SAME nodes are
+        # not poisoned — the next (deadline-free) read computes them
+        np.testing.assert_allclose(z.numpy(), (np_a + 1.0) * 2.0,
+                                   rtol=1e-6, atol=1e-6)
+        # and a fresh chain works too
+        z2 = (ht.array(np_a, split=0) + 1.0) * 2.0
+        np.testing.assert_allclose(z2.numpy(), (np_a + 1.0) * 2.0,
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_defer_time_admission_kills_expired_request_at_first_op(self):
+        from heat_tpu.core import profiler
+
+        resilience = self._resilience()
+        np_a, _ = _np_pair(_EVEN)
+        x = ht.array(np_a, split=0)
+        with profiler.request("defer-adm", deadline_s=-1.0):
+            with self.assertRaises(resilience.DeadlineExceeded):
+                x + 1.0  # dies at the first deferred op, before any graph
+
+    def test_queued_expired_item_cancelled_pre_dispatch(self):
+        resilience = self._resilience()
+        np_a, _ = _np_pair(_EVEN)
+        (ht.array(np_a, split=0) + 1.0) * 2.0  # signature warm-up fodder
+        sched = _executor._get_scheduler()
+        outcomes = {}
+        sched.pause()
+        try:
+            t = threading.Thread(
+                target=self._force_under_request,
+                args=("exp", 0.15, np_a, outcomes), daemon=True,
+            )
+            t.start()
+            deadline = time.monotonic() + 30.0
+            while sched.depth() < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            self.assertGreaterEqual(sched.depth(), 1, "force never queued")
+            time.sleep(0.3)  # the queued item's deadline passes
+        finally:
+            sched.resume()
+        t.join(30.0)
+        status, err = outcomes["exp"]
+        self.assertEqual(status, "err")
+        self.assertIsInstance(err, resilience.DeadlineExceeded)
+        self.assertGreaterEqual(ht.executor_stats()["expired_requests"], 1)
+
+    def test_batch_formation_excludes_expired_peers(self):
+        resilience = self._resilience()
+        datas = [np.full(_EVEN, float(i + 1), np.float32) for i in range(3)]
+        for d in datas:
+            ((ht.array(d, split=0) + 1.0) * 2.0).parray  # warm: batches replay
+        ht.reset_executor_stats()
+        sched = _executor._get_scheduler()
+        outcomes = {}
+        sched.pause()
+        try:
+            threads = [
+                threading.Thread(
+                    target=self._force_under_request,
+                    args=(f"b{i}", 0.15 if i == 0 else 60.0, datas[i],
+                          outcomes),
+                    daemon=True,
+                )
+                for i in range(3)
+            ]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 30.0
+            while sched.depth() < 3 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            self.assertGreaterEqual(sched.depth(), 3, "forces never queued")
+            time.sleep(0.3)  # b0's deadline passes in the queue
+        finally:
+            sched.resume()
+        for t in threads:
+            t.join(30.0)
+        status0, err0 = outcomes["b0"]
+        self.assertEqual(status0, "err")
+        self.assertIsInstance(err0, resilience.DeadlineExceeded)
+        for i in (1, 2):
+            status, got = outcomes[f"b{i}"]
+            self.assertEqual(status, "ok", f"b{i}: {got}")
+            np.testing.assert_allclose(got, (datas[i] + 1.0) * 2.0,
+                                       rtol=1e-6, atol=1e-6)
+        stats = ht.executor_stats()
+        # the two healthy peers batched WITHOUT the expired one widening them
+        self.assertGreaterEqual(stats["expired_requests"], 1)
+        self.assertNotIn(3, stats["batch_width_hist"])
+
+    def test_cancel_tag_fails_only_that_tenants_queued_items(self):
+        resilience = self._resilience()
+        datas = [np.full(_EVEN, float(i + 10), np.float32) for i in range(2)]
+        for d in datas:
+            ((ht.array(d, split=0) + 1.0) * 2.0).parray
+        sched = _executor._get_scheduler()
+        outcomes = {}
+        sched.pause()
+        try:
+            threads = [
+                threading.Thread(
+                    target=self._force_under_request,
+                    args=(f"c{i}", None, datas[i], outcomes), daemon=True,
+                )
+                for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 30.0
+            while sched.depth() < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            self.assertGreaterEqual(sched.depth(), 2, "forces never queued")
+            self.assertEqual(sched.cancel("c0"), 1)
+        finally:
+            sched.resume()
+        for t in threads:
+            t.join(30.0)
+        status0, err0 = outcomes["c0"]
+        self.assertEqual(status0, "err")
+        self.assertIsInstance(err0, resilience.RequestCancelled)
+        status1, got1 = outcomes["c1"]
+        self.assertEqual(status1, "ok", f"c1: {got1}")
+        np.testing.assert_allclose(got1, (datas[1] + 1.0) * 2.0,
+                                   rtol=1e-6, atol=1e-6)
+        self.assertGreaterEqual(ht.executor_stats()["cancelled_requests"], 1)
+
+    def test_queue_full_shed_mode_delivers_typed_shed(self):
+        resilience = self._resilience()
+        np_a, _ = _np_pair(_EVEN)
+        ((ht.array(np_a, split=0) + 1.0) * 2.0).parray  # warm
+        with _env("HEAT_TPU_SHED", "1"):
+            with _env("HEAT_TPU_DISPATCH_QUEUE", "1"):
+                sched = _executor._get_scheduler()
+                outcomes = {}
+                sched.pause()
+                try:
+                    threads = [
+                        threading.Thread(
+                            target=self._force_under_request,
+                            args=(f"qf{i}", 30.0, np_a, outcomes),
+                            daemon=True,
+                        )
+                        for i in range(3)
+                    ]
+                    for t in threads:
+                        t.start()
+                    deadline = time.monotonic() + 30.0
+                    # bound 1: one item queues, the others exhaust the
+                    # backpressure ladder and shed
+                    while (
+                        len(outcomes) < 2 and time.monotonic() < deadline
+                    ):
+                        time.sleep(0.01)
+                finally:
+                    sched.resume()
+                for t in threads:
+                    t.join(30.0)
+        sheds = [v for v in outcomes.values()
+                 if v[0] == "err" and isinstance(v[1], resilience.Shed)]
+        oks = [v for v in outcomes.values() if v[0] == "ok"]
+        self.assertGreaterEqual(len(sheds), 1, outcomes)
+        self.assertEqual(len(sheds) + len(oks), 3,
+                         f"a request vanished untyped: {outcomes}")
+        for _, got in oks:
+            np.testing.assert_allclose(got, (np_a + 1.0) * 2.0,
+                                       rtol=1e-6, atol=1e-6)
+        self.assertGreaterEqual(ht.executor_stats()["shed_requests"], 1)
+
+    def test_ewma_infeasible_admission_shed(self):
+        from heat_tpu.core import profiler
+
+        resilience = self._resilience()
+        np_a, _ = _np_pair(_EVEN)
+        for _ in range(3):  # compile + replays so the EWMA is live
+            ((ht.array(np_a, split=0) + 1.0) * 2.0).parray
+        progs = [
+            p for p in _executor._programs.values()
+            if p is not _executor.UNSUPPORTED
+            and (p.label or "").startswith("defer:")
+        ]
+        self.assertTrue(progs)
+        old = [(p, p.ewma_s) for p in progs]
+        for p in progs:
+            p.ewma_s = 10.0  # estimated service time >> any sane budget
+        try:
+            with _env("HEAT_TPU_SHED", "1"):
+                with profiler.request("ewma", deadline_s=0.5):
+                    x = ht.array(np_a, split=0)
+                    v = (x + 1.0) * 2.0
+                    with self.assertRaises(resilience.Shed):
+                        v.parray
+        finally:
+            for p, e in old:
+                p.ewma_s = e
+        self.assertGreaterEqual(ht.executor_stats()["shed_requests"], 1)
+        # without shed mode the same (pessimistic) estimate never rejects
+        with profiler.request("ewma2", deadline_s=30.0):
+            x = ht.array(np_a, split=0)
+            np.testing.assert_allclose(((x + 1.0) * 2.0).numpy(),
+                                       (np_a + 1.0) * 2.0,
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_drain_timeout_raises_typed_error_naming_futures(self):
+        resilience = self._resilience()
+        np_a, _ = _np_pair(_EVEN)
+        ((ht.array(np_a, split=0) + 1.0) * 2.0).parray  # warm
+        sched = _executor._get_scheduler()
+        outcomes = {}
+        sched.pause()
+        threads = [
+            threading.Thread(
+                target=self._force_under_request,
+                args=(f"d{i}", None, np_a, outcomes), daemon=True,
+            )
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30.0
+        while sched.depth() < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        self.assertGreaterEqual(sched.depth(), 2, "forces never queued")
+        # timeout=0 with the drain thread still parked behind our own _cv
+        # acquisition: deterministic timeout — every queued item is shed with
+        # the SAME DrainTimeout the call raises, so no reader can block
+        with self.assertRaises(resilience.DrainTimeout) as ctx:
+            sched.drain(timeout=0.0)
+        self.assertEqual(len(ctx.exception.undelivered), 2)
+        for name in ctx.exception.undelivered:
+            self.assertIn("#", name)  # tenant#seq:label naming
+        for t in threads:
+            t.join(30.0)
+        for tag, (status, err) in outcomes.items():
+            self.assertEqual(status, "err", f"{tag} was not failed")
+            self.assertIsInstance(err, resilience.DrainTimeout)
+        # draining: admission is closed, submits fall back to inline — work
+        # still completes, nothing is dropped
+        self.assertTrue(sched.draining())
+        np.testing.assert_allclose(
+            ((ht.array(np_a, split=0) + 1.0) * 2.0).numpy(),
+            (np_a + 1.0) * 2.0, rtol=1e-6, atol=1e-6,
+        )
+        sched.reopen()
+        self.assertFalse(sched.draining())
+
+    def test_drain_flushes_quietly_when_queue_settles(self):
+        np_a, _ = _np_pair(_EVEN)
+        ((ht.array(np_a, split=0) + 1.0) * 2.0).parray  # warm
+        sched = _executor._get_scheduler()
+        outcomes = {}
+        sched.pause()
+        t = threading.Thread(
+            target=self._force_under_request,
+            args=("flush", None, np_a, outcomes), daemon=True,
+        )
+        t.start()
+        deadline = time.monotonic() + 30.0
+        while sched.depth() < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        self.assertGreaterEqual(sched.depth(), 1, "force never queued")
+        result = sched.drain(timeout=30.0)  # lifts the pause, flushes
+        self.assertTrue(result["flushed"])
+        t.join(30.0)
+        status, got = outcomes["flush"]
+        self.assertEqual(status, "ok", f"flush: {got}")
+        np.testing.assert_allclose(got, (np_a + 1.0) * 2.0,
+                                   rtol=1e-6, atol=1e-6)
+        sched.reopen()
+
+    def test_deadline_off_stats_and_paths_untouched(self):
+        # a process that HAS armed deadlines still runs deadline-free
+        # requests through the unchanged path: no lifecycle counts, no
+        # rejections, exact bits
+        np_a, np_b = _np_pair(_RAGGED)
+        before = ht.executor_stats()
+        a, b = ht.array(np_a, split=0), ht.array(np_b, split=0)
+        got = ((a + b) * 2.0).numpy()
+        after = ht.executor_stats()
+        np.testing.assert_allclose(got, (np_a + np_b) * 2.0,
+                                   rtol=1e-6, atol=1e-6)
+        for key in ("expired_requests", "shed_requests",
+                    "cancelled_requests"):
+            self.assertEqual(after[key], before[key])

@@ -325,6 +325,90 @@ class TestRequestPropagation(_ProfTestCase):
         self.assertLessEqual(dispatch_pids, rids["t1"] | rids["t2"])
 
 
+class TestDeadlineCapture(_ProfTestCase):
+    """ISSUE 10: `request(tag, deadline_s=...)` arms a wall-clock deadline in
+    the same contextvar scope as the request id; `Deferred` nodes capture it
+    at defer time, so a chain forced later — from ANOTHER thread, after the
+    scope closed — still carries its deadline; and an already-expired
+    deadline at force time yields a typed `DeadlineExceeded`, never a hang
+    and never a silent full execution."""
+
+    def test_64_op_chain_carries_deadline_when_forced_from_another_thread(self):
+        from heat_tpu.core import resilience
+
+        _executor.clear_executor_cache()
+        profiler.enable()
+        with profiler.request("dl-chain", deadline_s=60.0) as rid:
+            self.assertIsNotNone(profiler.current_deadline())
+            x = ht.array(np.arange(32, dtype=np.float32), split=0)
+            y = ht.array(np.full(32, 0.25, dtype=np.float32), split=0)
+            z = _chain64(x, y)
+        # the scope is closed: no ambient deadline on this thread anymore...
+        self.assertIsNone(profiler.current_deadline())
+        # ...but the pending nodes captured it at defer time
+        node = z._payload
+        self.assertIsInstance(node, _executor.Deferred)
+        self.assertIsNotNone(node.deadline)
+        # forced from another thread, the (far-future) deadline rides along
+        # and the chain completes normally, attributed to the request
+        results, errors = [], []
+
+        def force():
+            try:
+                results.append(np.asarray(z.parray))
+            except BaseException as exc:  # pragma: no cover - the assertion
+                errors.append(exc)
+
+        th = threading.Thread(target=force)
+        th.start()
+        th.join(60.0)
+        self.assertFalse(errors, errors)
+        self.assertEqual(len(results), 1)
+        del resilience  # imported for symmetry with the expiry test below
+
+    def test_expired_deadline_at_force_time_is_typed_not_a_hang(self):
+        from heat_tpu.core import resilience
+
+        _executor.clear_executor_cache()
+        profiler.enable()
+        with profiler.request("dl-exp", deadline_s=0.2):
+            x = ht.array(np.arange(32, dtype=np.float32), split=0)
+            y = ht.array(np.full(32, 0.25, dtype=np.float32), split=0)
+            z = _chain64(x, y)
+        import time as _time
+
+        _time.sleep(0.3)  # the captured deadline expires before any force
+        before = ht.executor_stats()
+        outcome = {}
+
+        def force():
+            try:
+                outcome["v"] = np.asarray(z.parray)
+            except BaseException as exc:
+                outcome["err"] = exc
+
+        th = threading.Thread(target=force)
+        th.start()
+        th.join(30.0)
+        self.assertFalse(th.is_alive(), "force hung on an expired deadline")
+        self.assertIn("err", outcome,
+                      "expired deadline silently executed the full chain")
+        self.assertIsInstance(outcome["err"], resilience.DeadlineExceeded)
+        after = ht.executor_stats()
+        # rejected at admission: the 64-op program was never planned/compiled
+        self.assertEqual(after["misses"], before["misses"])
+        self.assertEqual(after["retraces"], before["retraces"])
+        self.assertGreater(after["expired_requests"],
+                           before["expired_requests"])
+        # the rejection consumed the captured deadline: the same chain is
+        # computable by a later, deadline-free read (bit-identical to a
+        # fresh, never-deadlined build of the identical graph)
+        x2 = ht.array(np.arange(32, dtype=np.float32), split=0)
+        y2 = ht.array(np.full(32, 0.25, dtype=np.float32), split=0)
+        exp = np.asarray(_chain64(x2, y2).parray)
+        np.testing.assert_array_equal(np.asarray(z.parray), exp)
+
+
 class TestMemoryGauges(_ProfTestCase):
     def test_force_boundary_samples(self):
         profiler.enable()

@@ -205,6 +205,86 @@ class TestCircuitBreaker(_ResilienceCase):
         br.record_failure()
         self.assertEqual(br.state, resilience.CLOSED)
 
+    def test_half_open_admits_exactly_one_probe_per_window(self):
+        clock = _FakeClock()
+        br = resilience.CircuitBreaker("t.probe", failure_threshold=1,
+                                       cooldown_s=60.0, clock=clock)
+        br.record_failure("down")
+        clock.t += 61.0
+        self.assertEqual(br.state, resilience.HALF_OPEN)
+        self.assertTrue(br.allows())      # the ONE trial probe of this window
+        self.assertFalse(br.allows())     # everyone else sees it as open
+        self.assertFalse(br.allows())
+        self.assertTrue(br.snapshot()["half_open_probe_out"])
+        br.record_failure("trial failed")  # probe reports: re-open
+        self.assertEqual(br.state, resilience.OPEN)
+        clock.t += 61.0
+        self.assertTrue(br.allows())      # fresh window, fresh single token
+        self.assertFalse(br.allows())
+        br.record_success()
+        self.assertEqual(br.state, resilience.CLOSED)
+        self.assertTrue(br.allows())      # closed: everyone passes again
+        self.assertTrue(br.allows())
+
+    def test_half_open_vanished_probe_forfeits_after_another_cooldown(self):
+        clock = _FakeClock()
+        br = resilience.CircuitBreaker("t.vanish", failure_threshold=1,
+                                       cooldown_s=30.0, clock=clock)
+        br.record_failure("down")
+        clock.t += 31.0
+        self.assertTrue(br.allows())   # probe holder... who never reports back
+        self.assertFalse(br.allows())
+        clock.t += 31.0                # a whole cooldown with no verdict
+        self.assertTrue(br.allows())   # new window: the token re-grants
+        self.assertFalse(br.allows())
+
+    def test_half_open_deadline_failed_trial_releases_the_probe_token(self):
+        clock = _FakeClock()
+        br = resilience.CircuitBreaker("t.dlprobe", failure_threshold=1,
+                                       cooldown_s=60.0, clock=clock)
+        br.record_failure("down")
+        clock.t += 61.0
+        pol = resilience.Policy(max_attempts=3, backoff_base=0.0)
+
+        def trial_whose_request_expired():
+            raise resilience.DeadlineExceeded("budget gone mid-trial")
+
+        with pytest.raises(resilience.DeadlineExceeded):
+            pol.run("t.dlprobe", trial_whose_request_expired,
+                    breaker=br, sleep=lambda s: None, clock=clock)
+        # the trial said nothing about the backend: the token is released so
+        # the NEXT caller probes now instead of waiting out another cooldown
+        self.assertEqual(br.state, resilience.HALF_OPEN)
+        self.assertTrue(br.allows())
+
+    def test_half_open_concurrent_threads_get_one_probe(self):
+        import threading
+
+        clock = _FakeClock()
+        br = resilience.CircuitBreaker("t.herd", failure_threshold=1,
+                                       cooldown_s=60.0, clock=clock)
+        br.record_failure("down")
+        clock.t += 61.0
+        barrier = threading.Barrier(16)
+        grants = []
+
+        def caller():
+            barrier.wait()
+            if br.allows():
+                grants.append(threading.get_ident())
+
+        threads = [threading.Thread(target=caller) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        self.assertEqual(
+            len(grants), 1,
+            f"{len(grants)} threads got the half-open probe (thundering herd)",
+        )
+        # the breaker re-probed a down backend ONCE, not 16 times
+        self.assertGreaterEqual(br.snapshot()["short_circuits"], 15)
+
 
 # ------------------------------------------------------------------ fault plans
 class TestFaultPlan(_ResilienceCase):
@@ -424,7 +504,9 @@ class TestChaosAsyncExecutor(_ResilienceCase):
         sched = _executor._dispatch_scheduler
         if sched is not None:
             sched.resume()
-            sched.wait_idle(30.0)
+            # wait_idle's bool must be checked: a timed-out wait here means a
+            # stuck scheduler leaking into every later test
+            self.assertTrue(sched.wait_idle(30.0), "scheduler stuck busy")
         super().tearDown()
 
     def test_fault_inside_queued_execution_replays_eager_no_data_loss(self):
@@ -516,6 +598,229 @@ class TestChaosAsyncExecutor(_ResilienceCase):
         )
 
 
+# --------------------------------------------------- chaos: request lifecycle
+class TestChaosLifecycle(_ResilienceCase):
+    """ISSUE 10: the `deadline-exceeded` fault kind fired inside queued and
+    batched executions, plus drain-under-load — in every case each
+    outstanding ``PendingValue`` is fulfilled with a value or a TYPED error,
+    never stranded, and over-deadline work is never salvaged by the eager
+    replay (no quarantine: the signature stays healthy)."""
+
+    def _sched(self):
+        import threading
+        import time
+
+        sched = _executor._get_scheduler()
+        sched.reopen()
+        sched.resume()
+        self.assertTrue(sched.wait_idle(30.0))
+        return sched, threading, time
+
+    def tearDown(self):
+        sched = _executor._dispatch_scheduler
+        if sched is not None:
+            sched.reopen()
+            sched.resume()
+            self.assertTrue(sched.wait_idle(30.0), "scheduler stuck busy")
+        super().tearDown()
+
+    def test_deadline_fault_inside_queued_execution_is_typed_then_retries(self):
+        sched, threading, time = self._sched()
+        _executor.clear_executor_cache()
+        np_a = np.linspace(-2.0, 2.0, 16, dtype=np.float32)
+        x = ht.array(np_a, split=0)
+        expected = ((x + 1.0) * 2.0 - 0.5).numpy()  # warm + reference bits
+        diagnostics.enable()
+        outcome = {}
+
+        def force():
+            try:
+                outcome["v"] = ((x + 1.0) * 2.0 - 0.5).numpy()
+            except Exception as exc:
+                outcome["err"] = exc
+
+        sched.pause()
+        try:
+            th = threading.Thread(target=force, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 30.0
+            while sched.depth() < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            self.assertGreaterEqual(sched.depth(), 1, "force never queued")
+            # fires inside the QUEUED execution, exactly once
+            resilience.arm_fault_plan(
+                [{"site": "executor.execute", "on_call": 1, "count": 1,
+                  "kind": "deadline-exceeded"}]
+            )
+        finally:
+            sched.resume()
+        th.join(60.0)
+        # the reader got the TYPED error — not a hang, not a silent eager
+        # replay of over-deadline work
+        self.assertIn("err", outcome, outcome)
+        self.assertIsInstance(outcome["err"], resilience.DeadlineExceeded)
+        stats = ht.executor_stats()
+        self.assertGreaterEqual(stats["expired_requests"], 1)
+        self.assertEqual(stats["eager_fallbacks"], 0,
+                         "over-deadline work must not replay eagerly")
+        self.assertEqual(stats.get("quarantined", {}), {},
+                         "a deadline expiry is not a signature failure")
+        # the fault window has passed: the next force retries cleanly
+        np.testing.assert_array_equal(((x + 1.0) * 2.0 - 0.5).numpy(), expected)
+
+    def test_deadline_fault_inside_batched_execution_strands_nothing(self):
+        sched, threading, time = self._sched()
+        _executor.clear_executor_cache()
+        datas = [
+            np.linspace(-1.0, 1.0, 16, dtype=np.float32) * (i + 1)
+            for i in range(2)
+        ]
+        arrs = [ht.array(d, split=0) for d in datas]
+        expected = [((a * 2.0) + 1.0).numpy() for a in arrs]  # warm, unbatched
+        diagnostics.enable()
+        got = [None, None]
+        errors = []
+
+        def force(i):
+            try:
+                got[i] = ((arrs[i] * 2.0) + 1.0).numpy()
+            except Exception as exc:
+                errors.append(exc)
+
+        sched.pause()
+        try:
+            threads = [
+                threading.Thread(target=force, args=(i,), daemon=True)
+                for i in range(2)
+            ]
+            for th in threads:
+                th.start()
+            deadline = time.monotonic() + 30.0
+            while sched.depth() < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            self.assertGreaterEqual(sched.depth(), 2, "forces never queued")
+            # fires once, inside the BATCHED call. The batch degrades to
+            # singles; each single re-checks ITS OWN deadline (none armed
+            # here), so both requests complete — per-item deadlines are why
+            # one item's expiry must never fail a whole batch
+            resilience.arm_fault_plan(
+                [{"site": "executor.execute", "on_call": 1, "count": 1,
+                  "kind": "deadline-exceeded"}]
+            )
+        finally:
+            sched.resume()
+        for th in threads:
+            th.join(60.0)
+        self.assertFalse(errors, errors)
+        for i in range(2):
+            np.testing.assert_array_equal(got[i], expected[i])
+        self.assertEqual(ht.executor_stats().get("quarantined", {}), {})
+
+    def test_drain_under_load_strands_no_future(self):
+        sched, threading, time = self._sched()
+        _executor.clear_executor_cache()
+        datas = [
+            np.linspace(-1.0, 1.0, 32, dtype=np.float32) * (i + 1)
+            for i in range(6)
+        ]
+        arrs = [ht.array(d, split=0) for d in datas]
+        for a in arrs:
+            ((a * 1.5) + 0.5).parray  # warm
+        outcomes = [None] * 6
+
+        def force(i):
+            try:
+                outcomes[i] = ("ok", ((arrs[i] * 1.5) + 0.5).numpy())
+            except BaseException as exc:
+                outcomes[i] = ("err", exc)
+
+        sched.pause()  # build a queue mid-"load"
+        threads = [
+            threading.Thread(target=force, args=(i,), daemon=True)
+            for i in range(6)
+        ]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 30.0
+        while sched.depth() < 6 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        self.assertGreaterEqual(sched.depth(), 6, "forces never queued")
+        # drain with a real timeout: lifts the pause, flushes everything
+        result = sched.drain(timeout=60.0)
+        self.assertTrue(result["flushed"])
+        for th in threads:
+            th.join(60.0)
+        for i, out in enumerate(outcomes):
+            self.assertIsNotNone(out, f"reader {i} stranded")
+            status, payload = out
+            if status == "ok":
+                np.testing.assert_allclose(
+                    payload, datas[i] * 1.5 + 0.5, rtol=1e-6, atol=1e-6
+                )
+            else:  # a typed lifecycle error is acceptable; a hang was not
+                self.assertIsInstance(
+                    payload,
+                    (resilience.DrainTimeout, resilience.Shed,
+                     resilience.RequestCancelled),
+                )
+        sched.reopen()
+
+    def test_atexit_drain_settles_queued_futures_in_subprocess(self):
+        """Interpreter shutdown with a PAUSED scheduler and a queued force:
+        the executor's atexit drain must settle the dispatch-done future
+        (value or typed error) and the process must exit cleanly — no hang."""
+        script = r"""
+import atexit, threading, time
+import numpy as np
+
+state = {}
+
+def check():  # registered BEFORE heat_tpu: runs AFTER the executor's drain
+    pv = state.get("pending")
+    if pv is None:
+        print("VERDICT: no-pending")
+    elif pv.done():
+        print("VERDICT: settled failed=%s" % pv.failed())
+    else:
+        print("VERDICT: STRANDED")
+
+atexit.register(check)
+
+import heat_tpu as ht
+from heat_tpu.core import _executor, _scheduler
+
+sched = _executor._get_scheduler()
+sched.pause()
+np_a = np.arange(16, dtype=np.float32)
+x = ht.array(np_a, split=0)
+v = (x + 7.0) * 2.0
+
+def read():
+    v.parray  # blocks on the paused queue
+
+t = threading.Thread(target=read, daemon=True)
+t.start()
+deadline = time.monotonic() + 30.0
+while sched.depth() < 1 and time.monotonic() < deadline:
+    time.sleep(0.005)
+assert sched.depth() >= 1, "force never queued"
+pv = v._payload.value
+assert isinstance(pv, _scheduler.PendingValue), type(pv)
+state["pending"] = pv
+print("QUEUED ok")
+# main exits here with the scheduler paused: only the atexit drain can
+# settle the future
+"""
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        self.assertEqual(proc.returncode, 0, proc.stderr)
+        self.assertIn("QUEUED ok", proc.stdout, proc.stdout)
+        self.assertIn("VERDICT: settled", proc.stdout,
+                      f"stdout={proc.stdout!r} stderr={proc.stderr[-500:]!r}")
 
 
 # ------------------------------------------------------------------ chaos: checkpoint
@@ -783,6 +1088,53 @@ class TestEnvCannedPlan(_ResilienceCase):
         )
         self.assertEqual(proc.returncode, 0, proc.stderr[-1000:])
         self.assertIn("CANNED_PLAN_OK", proc.stdout)
+
+    def test_env_canned_plan_deadline_exceeded_kind(self):
+        """ISSUE 10 chaos shape: an env-armed plan fires `deadline-exceeded`
+        inside a dispatch — the reader gets the TYPED error (no eager replay,
+        no quarantine) and the very next force retries clean."""
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        plan = [
+            {"site": "executor.execute", "on_call": 2, "count": 1,
+             "kind": "deadline-exceeded"},
+        ]
+        ndev = os.environ.get("HEAT_TPU_TEST_DEVICES", "8")
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={ndev}",
+            HEAT_TPU_FAULT_PLAN=json.dumps(plan),
+            HEAT_TPU_JIT_THRESHOLD="1",
+        )
+        code = (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import numpy as np\n"
+            "import heat_tpu as ht\n"
+            "from heat_tpu.core import resilience\n"
+            "assert resilience._armed, 'env plan must arm at import'\n"
+            "np_a = np.arange(10, dtype=np.float32)\n"
+            "y = (ht.array(np_a, split=0) + 1.0) * 2.0\n"
+            "np.testing.assert_array_equal(y.numpy(), (np_a + 1.0) * 2.0)\n"
+            "z = (ht.array(np_a * 2, split=0) + 1.0) * 2.0\n"
+            "try:\n"
+            "    z.numpy()\n"
+            "    raise SystemExit('fault did not surface')\n"
+            "except resilience.DeadlineExceeded:\n"
+            "    pass\n"
+            "np.testing.assert_array_equal(z.numpy(), (np_a * 2 + 1.0) * 2.0)\n"
+            "stats = ht.executor_stats()\n"
+            "assert stats['expired_requests'] >= 1, stats\n"
+            "assert stats['eager_fallbacks'] == 0, stats\n"
+            "assert not stats['quarantined'], stats\n"
+            "print('DEADLINE_PLAN_OK')\n"
+        ) % (here,)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+            timeout=300,
+        )
+        self.assertEqual(proc.returncode, 0, proc.stderr[-1000:])
+        self.assertIn("DEADLINE_PLAN_OK", proc.stdout)
 
 
 if __name__ == "__main__":

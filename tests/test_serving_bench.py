@@ -56,6 +56,16 @@ class TestServingHarness(TestCase):
         h50 = closed["latency_hist"]["p50_s"] * 1e3
         self.assertLess(abs(h50 - closed["p50_ms"]) / closed["p50_ms"], 0.25)
         self.assertEqual(len(collected), 2)
+        # ISSUE 10: every record carries the scheduler-pressure block so
+        # overload behaviour is visible in the bench trajectory
+        for rec in records:
+            sched = rec["scheduler"]
+            for key in ("queue_full_events", "queue_depth_peak",
+                        "queued_dispatches", "shed", "expired", "cancelled"):
+                self.assertIn(key, sched)
+            # a plain (deadline-free) run never sheds or cancels anything
+            self.assertEqual(sched["shed"], 0)
+            self.assertEqual(sched["cancelled"], 0)
 
     def test_trace_and_diag_artifacts(self):
         import tempfile
@@ -165,6 +175,9 @@ class TestMixedScenario(TestCase):
         self.assertEqual([r["workload"] for r in records], ["mixed", "mixed"])
         closed, open_ = records
         self.assertEqual(closed["metric"], "serving_mixed_closed_rps")
+        # the mixed record's scheduler block carries the per-workload
+        # lifecycle breakdown (all zero in a deadline-free run)
+        self.assertIn("per_workload", closed["scheduler"])
         # the interleave rotates deterministically over all four types
         self.assertEqual(set(closed["per_workload"]), set(BUILDERS))
         self.assertEqual(
@@ -251,3 +264,104 @@ class TestAsyncGateEvaluation(TestCase):
         )
         self.assertTrue(failed)
         self.assertTrue(any("warning" in r or "error" in r for r in out))
+
+class TestOverloadGateEvaluation(TestCase):
+    """The overload gate's record math (ISSUE 10; pure, no load run)."""
+
+    @staticmethod
+    def _score(offered=100, admitted=None, shed=0, failed=0, ok=None,
+               goodput=50.0, p99=100.0):
+        if admitted is None:
+            admitted = offered - shed - failed
+        return {
+            "offered": offered, "admitted": admitted, "shed": shed,
+            "failed": failed, "outcomes": {},
+            "accounted": admitted + shed + failed == offered,
+            "goodput_rps": goodput, "admitted_p99_ms": p99,
+            "shed_fraction": round(shed / offered, 4),
+            "deadline_ms": 50.0, "wall_s": 1.0,
+        }
+
+    def _rec(self, base, shed):
+        return [{"workload": "wl", "baseline": base, "shed": shed}]
+
+    def test_shed_preserves_while_baseline_collapses_passes(self):
+        from benchmarks.serving import overload_gate
+
+        comps = self._rec(
+            self._score(goodput=5.0, p99=1500.0),          # collapsed baseline
+            self._score(shed=60, goodput=40.0, p99=80.0),  # preserved shed arm
+        )
+        env = {"wl": {"min_goodput_rps": 18, "max_admitted_p99_ms": 400}}
+        self.assertFalse(overload_gate.evaluate(comps, env, emit=lambda s: None))
+
+    def test_shed_arm_collapse_fails(self):
+        from benchmarks.serving import overload_gate
+
+        comps = self._rec(
+            self._score(goodput=5.0, p99=1500.0),
+            self._score(shed=60, goodput=2.0, p99=900.0),  # shedding broken
+        )
+        env = {"wl": {"min_goodput_rps": 18, "max_admitted_p99_ms": 400}}
+        self.assertTrue(overload_gate.evaluate(comps, env, emit=lambda s: None))
+
+    def test_baseline_meeting_envelope_fails_the_gate(self):
+        from benchmarks.serving import overload_gate
+
+        # the "overload" did not collapse the baseline: the gate proves
+        # nothing and must say so
+        comps = self._rec(
+            self._score(goodput=40.0, p99=90.0),
+            self._score(shed=10, goodput=45.0, p99=80.0),
+        )
+        env = {"wl": {"min_goodput_rps": 18, "max_admitted_p99_ms": 400}}
+        self.assertTrue(overload_gate.evaluate(comps, env, emit=lambda s: None))
+
+    def test_broken_accounting_fails(self):
+        from benchmarks.serving import overload_gate
+
+        bad = self._score(shed=60, goodput=40.0, p99=80.0)
+        bad["admitted"] -= 1  # one request vanished untyped
+        bad["accounted"] = False
+        comps = self._rec(self._score(goodput=5.0, p99=1500.0), bad)
+        env = {"wl": {"min_goodput_rps": 18, "max_admitted_p99_ms": 400}}
+        out = []
+        self.assertTrue(overload_gate.evaluate(
+            comps, env, emit=lambda s: out.append(json.loads(s))))
+        self.assertTrue(any("accounting" in r.get("error", "") for r in out))
+
+    def test_missing_envelope_warns_visibly(self):
+        from benchmarks.serving import overload_gate
+
+        comps = self._rec(
+            self._score(goodput=5.0, p99=1500.0),
+            self._score(shed=60, goodput=40.0, p99=80.0),
+        )
+        out = []
+        # envelopes dict exists but has no entry for this workload -> warning
+        # plus a gate failure (nothing was actually gated)
+        self.assertTrue(overload_gate.evaluate(
+            comps, {}, emit=lambda s: out.append(json.loads(s))))
+        self.assertTrue(any("warning" in r for r in out))
+
+    def test_overload_baseline_covers_ci_matrix(self):
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(harness.__file__)),
+            "serving_baseline.json",
+        )
+        with open(path) as f:
+            baseline = json.load(f)
+        self.assertIn("_overload_gate", baseline)
+        envelopes = baseline["_overload_gate"]["envelopes"]
+        from benchmarks.serving import overload_gate
+
+        zoo = [name for name, _ in overload_gate.build_overload_workloads()]
+        for devices in ("3", "8"):
+            self.assertIn(devices, envelopes)
+            for name in zoo:
+                env = envelopes[devices].get(name)
+                self.assertIsNotNone(
+                    env, f"no overload envelope for {name} at {devices} devices"
+                )
+                self.assertGreater(env["min_goodput_rps"], 0)
+                self.assertGreater(env["max_admitted_p99_ms"], 0)
