@@ -83,6 +83,9 @@ def load_resilience():
         # the report section it could not register itself
         mod.diagnostics = diag
         diag.register_provider("resilience", mod.resilience_stats)
+        # same late binding the package import does at resilience's module
+        # bottom: diag.dump() commits atomically in the standalone stack too
+        diag._atomic_writer = mod.atomic_write
     _RESILIENCE = mod
     return mod
 

@@ -585,6 +585,70 @@ def _emit_cached_or_null(reason: str, fail_metric: str, extras=None) -> None:
     }))
 
 
+def _bench_telemetry(timeout_s: float = 300.0) -> dict:
+    """A hermetic telemetry-plane self-test gauge for ``extra_metrics``: a
+    virtual-CPU-mesh child enables ``ht.telemetry``, runs a guarded workload,
+    dumps one shard, merges it back through the public CLI surface, and fires
+    an injected fault so the flight recorder writes a post-mortem. Host-side
+    only — records every round, relay up or down."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        "import glob, json, os, sys, time\n"
+        "import heat_tpu as ht\n"
+        "from heat_tpu.core import diagnostics, profiler, resilience, telemetry\n"
+        "out = sys.argv[1]\n"
+        "diagnostics.enable(); profiler.enable(); telemetry.enable()\n"
+        "with profiler.request('selftest'):\n"
+        "    x = ht.arange(1001, split=0)\n"
+        "    (x * 2.0).sum().parray\n"
+        "resilience.arm_fault_plan([{'site': 'bench.telemetry', 'kind': 'raise', 'on_call': 1}])\n"
+        "try:\n"
+        "    resilience.maybe_fault('bench.telemetry')\n"
+        "except resilience.FaultInjected:\n"
+        "    pass\n"
+        "telemetry.dump_shard(os.path.join(out, 'shards'))\n"
+        "report = telemetry.merge(os.path.join(out, 'shards'))\n"
+        "for _ in range(100):\n"
+        "    if glob.glob(os.path.join(out, 'flight', '*.json')): break\n"
+        "    time.sleep(0.05)\n"
+        "print(json.dumps({'windows': len(telemetry.windows()),\n"
+        "                  'merged_counters': len(report['counters']),\n"
+        "                  'flight_dumps': len(glob.glob(os.path.join(out, 'flight', '*.json')))}))\n"
+    )
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=3",
+                   HEAT_TPU_FLIGHT_DIR=os.path.join(td, "flight"))
+        env.pop("HEAT_TPU_FAULT_PLAN", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", code, td],
+            capture_output=True, text=True, timeout=timeout_s, cwd=here, env=env,
+        )
+        gauges = {}
+        if proc.returncode == 0:
+            try:
+                gauges = json.loads(proc.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                pass
+    ok = bool(gauges) and gauges.get("windows", 0) > 0 and \
+        gauges.get("flight_dumps", 0) > 0
+    rec = {
+        "metric": "telemetry_selftest",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        **gauges,
+    }
+    if proc.returncode != 0:
+        rec["error"] = f"rc={proc.returncode}: {proc.stderr[-400:]}"
+    return rec
+
+
 def main():
     import sys
     import traceback
@@ -612,6 +676,10 @@ def main():
         traceback.print_exc(file=sys.stderr)
     try:
         dispatch_extras.append(_bench_analysis())
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    try:
+        dispatch_extras.append(_bench_telemetry())
     except Exception:
         traceback.print_exc(file=sys.stderr)
 

@@ -315,6 +315,7 @@ def run(
     baseline: dict = None,
     trace_out: str = None,
     diag_out: str = None,
+    telemetry_out: str = None,
     open_rps: dict = None,
     emit=print,
 ):
@@ -329,7 +330,7 @@ def run(
     gate verdict as a value instead of a ``SystemExit``."""
     import jax
 
-    from heat_tpu.core import diagnostics, profiler
+    from heat_tpu.core import diagnostics, profiler, telemetry
     from benchmarks.serving.workloads import build_workloads
 
     ndev = len(jax.devices())
@@ -343,6 +344,9 @@ def run(
 
     was_active = profiler.active()
     profiler.enable()
+    was_collecting = telemetry.collecting()
+    if telemetry_out:
+        telemetry.enable()  # the shard should carry collective windows too
     records, failed = [], False
 
     def suffixed(pick, mode):
@@ -425,9 +429,17 @@ def run(
         if diag_out:
             diagnostics.dump(diag_out)
             emit(json.dumps({"artifact": "diagnostics_json", "path": diag_out}))
+        if telemetry_out:
+            # one self-describing telemetry shard for this (single-process)
+            # run — the same artifact a multi-host deployment merges with
+            # `python -m heat_tpu.telemetry merge`
+            path = telemetry.dump_shard(telemetry_out)
+            emit(json.dumps({"artifact": "telemetry_shard", "path": path}))
     finally:
         if not was_active:
             profiler.disable()
+        if telemetry_out and not was_collecting:
+            telemetry.disable()
     return records, failed
 
 
@@ -455,6 +467,9 @@ if __name__ == "__main__":
                         "({devices: {workload: {min_rps, max_p50_ms, max_p99_ms}}})")
     parser.add_argument("--trace-out", help="dump the run's Perfetto trace here")
     parser.add_argument("--diag-out", help="dump the ht.diagnostics report here")
+    parser.add_argument("--telemetry-out",
+                        help="directory for this run's ht.telemetry shard "
+                        "(mergeable via `python -m heat_tpu.telemetry merge`)")
     args = parser.parse_args()
     _bootstrap(args.devices)
     baseline = None
@@ -471,6 +486,7 @@ if __name__ == "__main__":
         baseline=baseline,
         trace_out=args.trace_out,
         diag_out=args.diag_out,
+        telemetry_out=args.telemetry_out,
     )
     if args.check and failed:
         sys.exit(1)

@@ -96,6 +96,17 @@ LOCK_POLICY: Dict[str, ModulePolicy] = {
         # deadlines" section) — relaxed like _active
         relaxed={"_active", "_trace_path", "_deadline_seen"},
     ),
+    # telemetry.py "Thread-safety" section: window log, per-site seq +
+    # duration histograms, flight ring/ledger, process/clock identity all
+    # under the (strictly leaf) module _lock; _collecting is the relaxed
+    # hot-path switch and _in_flight_dump the thread-local reentrancy guard.
+    "heat_tpu.core.telemetry": ModulePolicy(
+        locks={"_lock": {
+            "_windows", "_site_seq", "_durations", "_flight", "_flight_dumps",
+            "_process", "_clock", "_last_auto_ns", "_auto_dumps",
+        }},
+        relaxed={"_collecting", "_in_flight_dump", "_flight_seq"},
+    ),
     # resilience.py zero-cost contract: _armed/_active are the relaxed gate
     # attributes; plan/breaker/policy registries mutate under _lock.
     "heat_tpu.core.resilience": ModulePolicy(

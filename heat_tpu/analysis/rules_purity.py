@@ -32,14 +32,15 @@ from typing import List
 
 from .engine import Finding, ModuleIndex, Universe, dotted_chain
 
-TELEMETRY_MODULES = {"diagnostics", "profiler"}
+TELEMETRY_MODULES = {"diagnostics", "profiler", "telemetry"}
 TELEMETRY_CALLS = {
     "counter", "span", "observe", "scope",
     "record_collective", "record_compile", "record_dispatch_event",
     "record_fallback", "record_resilience_event", "record_pad_waste",
     "record_backend_event", "record_counter", "record_force_memory",
+    "collective_window", "flight_record",
 }
-GATE_ATTRS = {"_enabled", "_tracing", "_active", "_armed"}
+GATE_ATTRS = {"_enabled", "_tracing", "_active", "_armed", "_collecting"}
 GATE_CALLS = {"enabled", "tracing", "executor_enabled"}
 
 TIME_MODULES = {"time", "random", "datetime"}

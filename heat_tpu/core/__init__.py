@@ -3,6 +3,7 @@
 from . import diagnostics
 from . import profiler
 from . import resilience
+from . import telemetry
 from .communication import *
 from ._executor import (
     executor_stats,

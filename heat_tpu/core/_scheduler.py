@@ -387,12 +387,16 @@ class DispatchScheduler:
         cumulative ``lifecycle.<kind>`` counter track."""
         with self._cv:
             total = self._count_lifecycle_locked(kind, tenant, n)
-        from . import diagnostics, profiler
+        from . import diagnostics, profiler, telemetry
 
         if diagnostics._enabled:
             diagnostics.counter(f"executor.{kind}", n)
         if profiler._active:
             profiler.record_counter(f"lifecycle.{kind}", total)
+        telemetry.flight_record(  # always-on ring: post-mortems need the tail
+            "lifecycle", f"scheduler.{kind}",
+            f"tenant={tenant or '<none>'} n={n} total={total}", kind=kind,
+        )
 
     def _deliver_lifecycle(self, item: WorkItem, kind: str,
                            exc: BaseException) -> None:
@@ -407,7 +411,7 @@ class DispatchScheduler:
                 item.fail(exc)
         except BaseException:  # pragma: no cover - belt: a bookkeeping bug in
             pass               # one item must not strand the rest
-        from . import diagnostics, profiler
+        from . import diagnostics, profiler, telemetry
 
         if diagnostics._enabled:
             diagnostics.counter(f"executor.{kind}", 1)
@@ -415,6 +419,9 @@ class DispatchScheduler:
             # cumulative sample; the bare read of the ledger is a relaxed
             # telemetry snapshot, not a synchronised count
             profiler.record_counter(f"lifecycle.{kind}", self.lifecycle[kind])
+        telemetry.flight_record(
+            "lifecycle", f"scheduler.{kind}", item.describe(), kind=kind,
+        )
 
     def _loop(self) -> None:
         from . import _executor  # late: the executor imports this module first
@@ -542,8 +549,19 @@ class DispatchScheduler:
         exc = resilience.DrainTimeout(
             timeout, [w.describe() for w in leftovers], still_active
         )
+        # futures FIRST: nothing downstream of this loop may strand a waiter
+        # (the telemetry tee below can try to spawn a dump thread, which can
+        # legitimately fail at interpreter shutdown — the atexit drain path)
         for w in leftovers:
             self._deliver_lifecycle(w, "shed", exc)
+        from . import diagnostics
+
+        # always-on resilience event: a timed-out drain is a typed failure
+        # path, and recording it is what triggers the flight recorder's
+        # automatic post-mortem dump (ht.telemetry)
+        diagnostics.record_resilience_event(
+            "scheduler.drain", "drain-timeout", str(exc)
+        )
         raise exc
 
     def reopen(self) -> None:

@@ -291,24 +291,43 @@ def save_checkpoint(tree: Any, directory: str, *, force: bool = True) -> None:
         _barrier(f"save:{directory}")
 
 
-def read_manifest(directory: str) -> dict:
+def read_manifest(directory: str, *, record: bool = True) -> dict:
     """The parsed manifest of a checkpoint directory, or :class:`CheckpointCorrupt`
-    when it is absent or unparseable (a torn / foreign / pre-manifest layout)."""
+    when it is absent or unparseable (a torn / foreign / pre-manifest layout).
+    Every corrupt verdict is recorded in the always-on resilience event stream
+    before raising — that record is what triggers the flight recorder's
+    automatic post-mortem dump (``ht.telemetry``). ``record=False`` skips the
+    event for callers that treat corruption as an expected, non-fatal answer
+    (the ``CheckpointManager`` step scan records its own softer
+    ``corrupt-step`` event instead of burning post-mortems on every scan of a
+    known-bad step)."""
     path = os.path.join(os.path.abspath(directory), MANIFEST_NAME)
     if not os.path.exists(path):
-        raise CheckpointCorrupt(
-            directory, [f"{MANIFEST_NAME} missing (incomplete or torn checkpoint)"]
+        raise _corrupt(
+            directory,
+            f"{MANIFEST_NAME} missing (incomplete or torn checkpoint)",
+            record,
         )
     try:
         with open(path) as fh:
             manifest = json.load(fh)
     except ValueError as exc:
-        raise CheckpointCorrupt(directory, [f"{MANIFEST_NAME} unparseable: {exc}"])
+        raise _corrupt(directory, f"{MANIFEST_NAME} unparseable: {exc}", record)
     if manifest.get("schema") != SCHEMA:
-        raise CheckpointCorrupt(
-            directory, [f"unknown manifest schema {manifest.get('schema')!r}"]
+        raise _corrupt(
+            directory, f"unknown manifest schema {manifest.get('schema')!r}", record
         )
     return manifest
+
+
+def _corrupt(directory: str, problem: str, record: bool) -> "CheckpointCorrupt":
+    """Build a :class:`CheckpointCorrupt`, recording the verdict first when
+    the caller is on a hard-failure path."""
+    if record:
+        diagnostics.record_resilience_event(
+            "checkpoint.manifest", "corrupt", f"{directory}: {problem}"
+        )
+    return CheckpointCorrupt(directory, [problem])
 
 
 def verify_checkpoint(directory: str, manifest: Optional[dict] = None) -> List[str]:
@@ -449,7 +468,7 @@ class CheckpointManager:
                 continue
             step = int(m.group(1))
             try:
-                read_manifest(os.path.join(self._directory, name))
+                read_manifest(os.path.join(self._directory, name), record=False)
             except CheckpointCorrupt as exc:
                 diagnostics.record_resilience_event(
                     "checkpoint.scan", "corrupt-step",

@@ -25,6 +25,7 @@ Multi-host bootstrap is ``jax.distributed.initialize`` instead of ``mpirun`` —
 from __future__ import annotations
 
 import math
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -35,12 +36,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from . import diagnostics, profiler, resilience
+from . import diagnostics, profiler, resilience, telemetry
 
 
 def _guarded(site, fn, *args, **kwargs):
-    """Run one collective (or layout) invocation under ht.resilience and
-    ht.profiler.
+    """Run one collective (or layout) invocation under ht.resilience,
+    ht.profiler, and ht.telemetry.
 
     Idle fast path: one module-attribute read per subsystem. When a fault plan
     is armed or a site policy is registered, the call goes through
@@ -48,9 +49,20 @@ def _guarded(site, fn, *args, **kwargs):
     policy retries. When the profiler is active the invocation is additionally
     recorded as a ``collective`` slice attributed to the ambient request scope
     — collectives run at trace time, so the slice nests inside the program's
-    ``compile`` slice (host-side timing only; nothing enters the traced body,
-    so the compiled HLO never changes — the byte-parity contracts in
-    ``tests/test_resilience.py`` and ``tests/test_profiler.py``)."""
+    ``compile`` slice. When telemetry collection is on, the whole invocation
+    (retries included) is timed into a :func:`telemetry.collective_window` —
+    the per-(site, seq) enter/exit record the cross-process merge turns into
+    skew histograms and straggler attribution. All of it is host-side timing
+    only; nothing enters the traced body, so the compiled HLO never changes
+    (the byte-parity contracts in ``tests/test_resilience.py`` and
+    ``tests/test_profiler.py``)."""
+    if telemetry._collecting:
+        with telemetry.collective_window(site):
+            return _guarded_run(site, fn, *args, **kwargs)
+    return _guarded_run(site, fn, *args, **kwargs)
+
+
+def _guarded_run(site, fn, *args, **kwargs):
     if profiler._active:
         with profiler.scope("collective", site):
             if resilience._active:
@@ -670,12 +682,73 @@ def _pad_reshard(
     return _guarded("comm.reshard", fn, array)
 
 
+_HANDSHAKE_TIMEOUT_MS = 60_000
+
+# Every bootstrap (import, then each explicit initialize()) gets its own
+# barrier id + KV namespace: coordination barriers cannot be re-waited and
+# KV keys cannot be re-set, and SPMD symmetry keeps the counter in step on
+# every process, so a re-init re-anchors instead of failing the handshake.
+_handshake_generation = 0
+
+
+def _telemetry_bootstrap() -> None:
+    """Stamp this process's rank into ht.telemetry and, on multi-process jobs,
+    run the boot-time clock-offset handshake: a coordination-service barrier,
+    then every process samples ``time.monotonic_ns()`` and publishes it
+    through the distributed KV store (one logical allgather of the anchors) —
+    the zero point that lets ``telemetry.merge`` align trace timestamps
+    across ranks. The handshake rides the ``jax.distributed`` coordination
+    channel, never an XLA computation, so it works on every backend (CPU
+    meshes included) and cannot touch any compiled program — HLO-untouched by
+    construction. Accuracy is the barrier's exit skew (sub-millisecond on one
+    host, network-RTT across hosts; the docs state the caveat)."""
+    global _handshake_generation
+    try:
+        telemetry.set_process_info(jax.process_index(), jax.process_count())
+        if (
+            jax.process_count() > 1
+            and os.environ.get("HEAT_TPU_TELEMETRY_HANDSHAKE") != "0"
+        ):
+            client = jax._src.distributed.global_state.client
+            if client is None:
+                raise RuntimeError("jax.distributed client not initialized")
+            gen = _handshake_generation
+            _handshake_generation += 1  # ht: ignore[lock-racing-increment] -- bootstrap-only: runs at module import and inside initialize(), both single-threaded launch paths; SPMD symmetry (not thread-safety) is what keeps the counter aligned
+            client.wait_at_barrier(
+                f"heat_tpu_telemetry_clock/{gen}", _HANDSHAKE_TIMEOUT_MS
+            )
+            anchor = time.monotonic_ns()
+            index = jax.process_index()
+            client.key_value_set(
+                f"heat_tpu/telemetry/anchor/{gen}/{index}", str(anchor)
+            )
+            anchors = [
+                int(client.blocking_key_value_get(
+                    f"heat_tpu/telemetry/anchor/{gen}/{i}", _HANDSHAKE_TIMEOUT_MS
+                ))
+                for i in range(jax.process_count())
+            ]
+            telemetry.record_clock_anchor(anchor, anchors)
+    except Exception as exc:
+        # a failed handshake must never block the job: the shards fall back
+        # to unaligned per-process anchors, and the degradation is accounted
+        # in the always-on resilience event stream
+        diagnostics.record_resilience_event(
+            "telemetry.handshake", "degraded", f"{type(exc).__name__}: {exc}"
+        )
+
+
 # --------------------------------------------------------------------------- singletons
 COMM_WORLD: MeshCommunication = MeshCommunication()
 """World communicator over all visible devices (reference ``MPI_WORLD`` ``communication.py:2013``)."""
 
 COMM_SELF: MeshCommunication = MeshCommunication(jax.devices()[:1])
 """Single-device communicator (reference ``MPI_SELF`` ``communication.py:2014``)."""
+
+# The env-contract bootstrap (module top) has already initialised
+# jax.distributed by this point, so rank identity and the clock handshake can
+# be stamped into the telemetry plane for every launch path.
+_telemetry_bootstrap()
 
 __default_comm = COMM_WORLD
 
@@ -730,3 +803,4 @@ def initialize(**kwargs) -> None:
     global COMM_WORLD, __default_comm
     COMM_WORLD = MeshCommunication()
     __default_comm = COMM_WORLD
+    _telemetry_bootstrap()

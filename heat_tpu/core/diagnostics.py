@@ -74,6 +74,10 @@ than locked:
 - the ``HEAT_TPU_DIAG_LOG`` file append in :func:`record_backend_event` runs
   OUTSIDE the lock (a slow disk must not stall telemetry); interleaved lines
   from two processes are whole-line atomic on POSIX appends of this size;
+- the late-bound collaborator hooks (``_atomic_writer``, ``_resilience_tee``,
+  ``_fallback_tee``) are written exactly once at their owning module's import
+  and read bare afterwards; tee invocations happen OUTSIDE ``_lock`` so the
+  flight-recorder ring's lock stays strictly below this one;
 - the executor's ``_stats`` tallies (in :mod:`_executor`) are PER-THREAD
   accumulator cells merged at report time: increments stay lock-free on the
   hot paths (``retraces`` inside a traced body, the memo-hit
@@ -147,6 +151,19 @@ _backend_state: Optional[bool] = None
 # ``executor_stats`` here) so this module never imports the package — it must
 # stay loadable standalone, before JAX, by the relay-probing entry points.
 _providers: Dict[str, Callable[[], Any]] = {}
+
+# Late-bound collaborators, installed by modules this one must not import
+# (each would be a cycle — resilience and telemetry both import diagnostics).
+# All three are written once at their owner's import and read bare afterwards
+# (relaxed, like the switches): ``_atomic_writer`` is
+# ``resilience.atomic_write`` so :func:`dump` commits whole artifacts;
+# ``_resilience_tee`` / ``_fallback_tee`` are ``telemetry.flight_record``
+# adapters so every failure-path event also lands in the flight-recorder ring
+# (and can trigger its automatic post-mortem dump). Tees are invoked OUTSIDE
+# ``_lock`` — the flight ring has its own lock and must stay a leaf.
+_atomic_writer: Optional[Callable[..., Any]] = None
+_resilience_tee: Optional[Callable[[str, str, str], None]] = None
+_fallback_tee: Optional[Callable[[str, str], None]] = None
 
 
 def _utcnow() -> str:
@@ -292,6 +309,9 @@ def record_fallback(site: str, reason: str) -> None:
     with _lock:
         _counters[f"fallback.{site}"] = _counters.get(f"fallback.{site}", 0) + 1
         _fallback_events.append(rec)
+    tee = _fallback_tee
+    if tee is not None:
+        tee(site, rec["reason"])
 
 
 def record_resilience_event(site: str, kind: str, detail: str = "") -> None:
@@ -304,6 +324,9 @@ def record_resilience_event(site: str, kind: str, detail: str = "") -> None:
     rec = {"t": _utcnow(), "site": site, "kind": kind, "detail": str(detail)}
     with _lock:
         _resilience_events.append(rec)
+    tee = _resilience_tee
+    if tee is not None:
+        tee(site, kind, rec["detail"])
 
 
 def record_pad_waste(gshape, split: int, padded_dim: int) -> None:
@@ -429,10 +452,25 @@ def report() -> dict:
 
 
 def dump(path: str) -> None:
-    """Write :func:`report` as JSON to ``path``."""
-    with open(path, "w") as f:
-        json.dump(report(), f, indent=2, sort_keys=True)
-        f.write("\n")
+    """Write :func:`report` as JSON to ``path``.
+
+    Routed through ``resilience.atomic_write`` (site ``diagnostics.dump``)
+    when the resilience module has installed itself: a crash mid-dump leaves
+    the previous artifact (or nothing), never a torn half-JSON — merged
+    telemetry reads these artifacts back, so partial writes must be
+    impossible, not just unlikely."""
+    payload = report()
+
+    def _write(target: str) -> None:
+        with open(target, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    writer = _atomic_writer
+    if writer is not None:
+        writer(path, _write, site="diagnostics.dump")
+    else:  # standalone load before resilience exists: plain write
+        _write(path)
 
 
 # ------------------------------------------------------------------ env bootstrap

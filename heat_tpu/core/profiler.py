@@ -97,6 +97,11 @@ try:
 except ImportError:  # loaded standalone by file path (no parent package)
     diagnostics = None
 
+try:
+    from . import resilience  # atomic trace dumps (crash-safe artifacts)
+except ImportError:  # loaded standalone by file path (no parent package)
+    resilience = None
+
 __all__ = [
     "Histogram",
     "enable",
@@ -115,6 +120,8 @@ __all__ = [
     "record_force_memory",
     "report",
     "dump_trace",
+    "trace_snapshot",
+    "trace_events",
     "SCHEMA",
     "TRACE_SCHEMA",
 ]
@@ -292,6 +299,51 @@ class Histogram:
         h.sum_s = float(snap["sum_s"])
         h.min_s = float(snap["min_s"]) if snap.get("min_s") is not None else math.inf
         h.max_s = float(snap["max_s"]) if snap.get("max_s") is not None else 0.0
+        return h
+
+    def delta(self, prev) -> "Histogram":
+        """The *windowed* histogram between an earlier :meth:`snapshot` of this
+        same stream (``prev`` — a snapshot dict or a Histogram) and now: bucket
+        counts subtract exactly, so interval p50/p99 between two dumps come out
+        of cumulative snapshots without any per-window state. Raises
+        ``ValueError`` when ``prev`` is not a prefix of this stream (some
+        bucket would go negative — the histograms are from different streams
+        or the stream was reset between the snapshots).
+
+        The window's true ``min_s``/``max_s`` are not recoverable from
+        cumulative counts; the delta clamps them to the occupied buckets'
+        bounds, so quantile estimates keep their usual half-bucket error
+        but the extremes are bucket-resolution, not sample-resolution.
+        ``merge(prev, delta)`` reproduces the cumulative bucket table exactly
+        (the round-trip the telemetry tests gate)."""
+        if isinstance(prev, dict):
+            prev = Histogram.from_snapshot(prev)
+        if (prev.base, prev.growth) != (self.base, self.growth):
+            raise ValueError(
+                f"cannot delta histograms with different bucket configs: "
+                f"({self.base}, {self.growth}) vs ({prev.base}, {prev.growth})"
+            )
+        h = Histogram(base=self.base, growth=self.growth)
+        for i in set(self.buckets) | set(prev.buckets):
+            c = self.buckets.get(i, 0) - prev.buckets.get(i, 0)
+            if c < 0:
+                raise ValueError(
+                    f"snapshot is not a prefix of this histogram: bucket {i} "
+                    f"has {self.buckets.get(i, 0)} < prior {prev.buckets.get(i, 0)}"
+                )
+            if c:
+                h.buckets[i] = c
+        h.count = self.count - prev.count
+        if h.count < 0 or h.count != sum(h.buckets.values()):
+            raise ValueError(
+                "snapshot is not a prefix of this histogram (count mismatch)"
+            )
+        h.sum_s = max(0.0, self.sum_s - prev.sum_s)
+        if h.buckets:
+            lo, hi = min(h.buckets), max(h.buckets)
+            h.min_s = self._bound(lo - 1) if lo > 0 else 0.0
+            h.max_s = min(self._bound(hi), self.max_s)
+            h.min_s = min(h.min_s, h.max_s)
         return h
 
 
@@ -547,22 +599,65 @@ def _requests_total() -> int:
     return sum(h.count for name, h in _hists.items() if name.startswith("request."))
 
 
-def _trace_events_locked() -> List[dict]:
+def _snapshot_locked() -> Dict[str, list]:
+    # callers hold _lock (the _locked-suffix convention)
+    return {
+        "requests": [
+            {"id": rid, "tag": e["tag"], "t0_us": e["t0_us"],
+             "t1_us": e["t1_us"]}
+            for rid, e in _requests.items()
+        ],
+        "slices": [list(s) for s in _slices],
+        "counter_events": [list(c) for c in _counter_events],
+    }
+
+
+def trace_snapshot() -> Dict[str, list]:
+    """The raw timeline data — requests, complete slices, counter samples —
+    as JSON-able lists. This is the per-process export ``ht.telemetry`` ships
+    inside a telemetry shard so ``telemetry.merge`` can rebuild ONE
+    cross-process trace with per-process track groups (``trace_events``
+    re-serialises a snapshot into Chrome trace events)."""
+    with _lock:
+        return _snapshot_locked()
+
+
+def trace_events(snapshot: Dict[str, list], *, pid_offset: int = 0,
+                 ts_shift_us: float = 0.0,
+                 process_label: Optional[str] = None) -> List[dict]:
+    """Serialise a :func:`trace_snapshot` into Chrome trace events.
+
+    ``pid_offset`` namespaces every track pid (request tracks become
+    ``pid_offset + rid``, the unattributed and counter tracks sit at
+    ``pid_offset`` itself) — the telemetry merger gives each process its own
+    disjoint pid range so two processes' request id 3 cannot collide on one
+    track, and cumulative counters from different ranks land on separate
+    tracks instead of summing into nonsense. ``ts_shift_us`` is added to every
+    timestamp (the merger's clock alignment); ``process_label`` prefixes the
+    track metadata names (``p1/request 3: kmeans``)."""
+    prefix = f"{process_label}/" if process_label else ""
     events: List[dict] = []
-    # one track (pid) per request, its tag as the process name; pid 0 is the
-    # unattributed track (framework work outside any request scope)
-    events.append({"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
-                   "args": {"name": "unattributed"}})
-    events.append({"name": "process_sort_index", "ph": "M", "pid": 0, "tid": 0,
-                   "args": {"sort_index": 0}})
-    for rid, entry in _requests.items():
-        events.append({"name": "process_name", "ph": "M", "pid": rid, "tid": 0,
-                       "args": {"name": f"request {rid}: {entry['tag']}"}})
-        events.append({"name": "process_sort_index", "ph": "M", "pid": rid,
-                       "tid": 0, "args": {"sort_index": rid}})
+    # one track (pid) per request, its tag as the process name; the offset
+    # base pid is the unattributed track (framework work outside any request)
+    events.append({"name": "process_name", "ph": "M", "pid": pid_offset,
+                   "tid": 0, "args": {"name": f"{prefix}unattributed"}})
+    events.append({"name": "process_sort_index", "ph": "M", "pid": pid_offset,
+                   "tid": 0, "args": {"sort_index": pid_offset}})
+    for entry in snapshot.get("requests", ()):
+        rid = entry["id"]
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid_offset + rid,
+            "tid": 0, "args": {"name": f"{prefix}request {rid}: {entry['tag']}"},
+        })
+        events.append({
+            "name": "process_sort_index", "ph": "M", "pid": pid_offset + rid,
+            "tid": 0, "args": {"sort_index": pid_offset + rid},
+        })
     be: List[tuple] = []
-    for seq, (rid, tid, cat, name, t0, t1) in enumerate(_slices):
-        pid = rid if rid is not None else 0
+    for seq, (rid, tid, cat, name, t0, t1) in enumerate(snapshot.get("slices", ())):
+        pid = pid_offset + (rid if rid is not None else 0)
+        t0 += ts_shift_us
+        t1 += ts_shift_us
         t1 = max(t1, t0 + 1e-3)  # floor at 1 ns: a zero-length slice must
         dur = t1 - t0            # still emit its B strictly before its E
         be.append((t0, 1, -dur, -seq, {"name": name, "cat": cat, "ph": "B",
@@ -576,26 +671,44 @@ def _trace_events_locked() -> List[dict]:
     # E closes before its co-timed parent E (dur, then seq ascending)
     be.sort(key=lambda e: (e[0], e[1], e[2], e[3]))
     events.extend(e[-1] for e in be)
-    for name, ts, value in _counter_events:
-        events.append({"name": name, "cat": "counter", "ph": "C", "pid": 0,
-                       "tid": 0, "ts": round(ts, 3), "args": {name: value}})
+    for name, ts, value in snapshot.get("counter_events", ()):
+        events.append({"name": name, "cat": "counter", "ph": "C",
+                       "pid": pid_offset, "tid": 0,
+                       "ts": round(ts + ts_shift_us, 3), "args": {name: value}})
     return events
+
+
+def _trace_events_locked() -> List[dict]:
+    # callers hold _lock (the _locked-suffix convention)
+    return trace_events(_snapshot_locked())
 
 
 def dump_trace(path: str) -> dict:
     """Write the recorded timeline as Chrome trace-event JSON (the object
     format: ``{"traceEvents": [...]}``) loadable in Perfetto /
     ``chrome://tracing``. Returns the written object (tests schema-check it
-    without re-reading the file)."""
+    without re-reading the file).
+
+    The write goes through ``resilience.atomic_write`` (site
+    ``profiler.trace``): a crash mid-dump leaves the previous artifact (or
+    nothing), never a torn half-JSON that a downstream ``telemetry.merge``
+    would choke on."""
     with _lock:
         obj = {
             "schema": TRACE_SCHEMA,
             "displayTimeUnit": "ms",
             "traceEvents": _trace_events_locked(),
         }
-    with open(path, "w") as f:
-        json.dump(obj, f)
-        f.write("\n")
+
+    def _write(target: str) -> None:
+        with open(target, "w") as f:
+            json.dump(obj, f)
+            f.write("\n")
+
+    if resilience is not None:
+        resilience.atomic_write(path, _write, site="profiler.trace")
+    else:  # standalone file-path load: no resilience instance to route through
+        _write(path)
     return obj
 
 

@@ -828,6 +828,10 @@ def resilience_stats() -> dict:
 
 if diagnostics is not None:
     diagnostics.register_provider("resilience", resilience_stats)
+    # diagnostics cannot import this module (cycle), so the atomic-dump
+    # primitive is installed into it here: diagnostics.dump commits whole
+    # artifacts from now on instead of risking a torn JSON mid-crash
+    diagnostics._atomic_writer = atomic_write
 
 # Env bootstrap: a plan armed by the environment applies to the whole process
 # (the CI chaos job's canned plans); a malformed plan fails LOUDLY here rather

@@ -1,0 +1,17 @@
+"""``ht.telemetry`` — public alias of :mod:`heat_tpu.core.telemetry` and the
+``python -m heat_tpu.telemetry`` CLI entry point.
+
+All state lives in :mod:`heat_tpu.core.telemetry` (one instance per process);
+this module re-exports its surface so ``ht.telemetry.merge(...)`` and
+``python -m heat_tpu.telemetry merge --dir shards/`` both work. See
+``doc/source/observability.rst`` ("Distributed telemetry") for the shard and
+merged-report schemas.
+"""
+
+from .core.telemetry import *  # noqa: F401,F403
+from .core.telemetry import main  # noqa: F401
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,116 @@
+"""Multi-controller telemetry worker: one SPMD process of an N-process job
+exercising the ISSUE-11 distributed telemetry plane end to end.
+
+Launched by tests/test_multiprocess.py with
+``python _mp_telemetry_worker.py <coordinator> <num_processes> <process_id>
+<tmpdir>``. Each process: verifies the bootstrap stamped its rank and ran the
+coordination-service clock handshake, enables diagnostics + profiler +
+telemetry, runs an identical sequence of guarded layout-op rounds separated
+by coordination barriers (so per-site sequence numbers AND round starts line
+up across ranks — deliberately no cross-process XLA computation, which this
+container's CPU backend cannot run; the guarded ``comm.shard`` chokepoint and
+the coordination channel are the surfaces under test), plants a deterministic
+straggler on the LAST rank via a fault-plan ``timeout`` at ``comm.shard``
+(retried under a registered site policy, stretching that rank's window by
+~0.6 s so its NEXT window's ENTER is late — the signature the skew scoreboard
+must attribute), and dumps a telemetry shard into ``<tmpdir>/shards``. The
+parent test merges the shards and asserts the global report. Prints
+``TELEMETRY_OK <pid>`` on success.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, nprocs, pid, tmpdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    # the env contract honoured by heat_tpu at import (communication.py header)
+    os.environ["HEAT_TPU_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["HEAT_TPU_NUM_PROCESSES"] = str(nprocs)
+    os.environ["HEAT_TPU_PROCESS_ID"] = str(pid)
+    # flight dumps land where the parent can assert on them
+    os.environ["HEAT_TPU_FLIGHT_DIR"] = os.path.join(tmpdir, "flight")
+
+    import numpy as np
+
+    import heat_tpu as ht  # noqa: F401 - the import runs the bootstrap
+    import jax
+    from heat_tpu.core import diagnostics, profiler, resilience, telemetry
+    from heat_tpu.core.communication import COMM_WORLD
+
+    straggler = nprocs - 1
+    client = jax._src.distributed.global_state.client
+
+    def barrier(name: str) -> None:
+        client.wait_at_barrier(f"ht_mp_telemetry_{name}", 60_000)
+
+    # --- bootstrap stamped rank + ran the clock handshake ---------------------
+    assert telemetry.process_info() == (pid, nprocs), telemetry.process_info()
+    clock = telemetry.clock_info()
+    assert clock["aligned"], clock
+    assert clock["anchors_ns"] is not None and len(clock["anchors_ns"]) == nprocs
+
+    diagnostics.enable()
+    profiler.enable()
+    telemetry.enable()
+
+    # --- exact-sum markers: merged value must be sum(pid + 1) ----------------
+    diagnostics.counter("mp.marker", pid + 1)
+    for i in range(4):
+        profiler.observe("mp.lat", 0.001 * (pid + 1) + 0.0001 * i)
+
+    # --- the planted straggler: a retried injected timeout at comm.shard -----
+    # site calls count PER ATTEMPT, and each round below makes two comm.shard
+    # calls, so calls 7+8 are round 3's first array build plus its first
+    # retry: ~0.2 + 0.4 s of backoff before attempt 3 (call 9) succeeds. The
+    # delay lands INSIDE window seq 7, so this rank ENTERS seq 8 late while
+    # the barrier keeps every round start aligned — the skew signature.
+    if pid == straggler:
+        resilience.set_policy(
+            "comm.shard", resilience.Policy(max_attempts=3, backoff_base=0.2)
+        )
+        resilience.arm_fault_plan([
+            {"site": "comm.shard", "kind": "timeout", "on_call": 7, "count": 2},
+        ])
+
+    g = np.arange(nprocs * 6 * 4, dtype=np.float32).reshape(nprocs * 6, 4)
+    rounds = 6
+    for r in range(rounds):
+        barrier(f"round{r}")
+        with profiler.request(f"round{r}"):
+            # two guarded layout ops (window seqs 2r+1, 2r+2) building REAL
+            # cross-process global arrays — construction only, no collective
+            # compute (unsupported on this container's CPU backend)
+            x = COMM_WORLD.shard(g + r, 0)
+            y = COMM_WORLD.shard(g * 2.0 + r, 0)
+        assert not x.is_fully_addressable  # genuinely cross-host
+        # the local shards hold exactly this process's chunk of the global
+        shard0 = x.addressable_shards[0]
+        np.testing.assert_allclose(
+            np.asarray(shard0.data), (g + r)[shard0.index], rtol=1e-6
+        )
+        del x, y
+
+    if pid == straggler:
+        resilience.disarm_fault_plan()
+        resilience.set_policy("comm.shard", None)
+        # the injected firings must be in the flight ring (fed by the tee)
+        kinds = {(e["kind"], e["site"]) for e in telemetry.flight_events()}
+        assert ("fault", "comm.shard") in kinds, sorted(kinds)
+
+    wins = telemetry.windows()
+    shard_sites = [w for w in wins if w[0] == "comm.shard"]
+    assert len(shard_sites) == 2 * rounds, len(shard_sites)
+
+    barrier("pre-dump")
+    out = telemetry.dump_shard(os.path.join(tmpdir, "shards"))
+    assert os.path.exists(out)
+    print(f"TELEMETRY_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

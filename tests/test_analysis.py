@@ -544,6 +544,7 @@ class TestRuntimeImportContract(unittest.TestCase):
                 os.path.join("heat_tpu", "core", "profiler.py"),
                 os.path.join("heat_tpu", "core", "resilience.py"),
                 os.path.join("heat_tpu", "core", "_scheduler.py"),
+                os.path.join("heat_tpu", "core", "telemetry.py"),
                 "_diag_bootstrap.py",
             ]
             for rel in rels:
@@ -568,7 +569,7 @@ class TestRuntimeImportContract(unittest.TestCase):
         )
         self.assertIn("STDLIB_ONLY_OK", proc.stdout)
         for rel in ("diagnostics.py", "profiler.py", "resilience.py",
-                    "_scheduler.py", "_diag_bootstrap.py"):
+                    "_scheduler.py", "telemetry.py", "_diag_bootstrap.py"):
             self.assertIn(rel, proc.stdout)
 
 

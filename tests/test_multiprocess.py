@@ -7,9 +7,20 @@ Every other test in this suite is single-process (one controller, 8 virtual
 devices); these are the only runs where ``jax.process_count() > 1`` branches —
 ``is_split`` assembly, cross-host ``numpy()``, the single-writer io contract —
 actually execute. See tests/_mp_worker.py for the per-process assertions.
+
+ISSUE 11 adds the distributed-telemetry job (tests/_mp_telemetry_worker.py):
+every process dumps a telemetry shard, the parent merges them and asserts the
+global report — exact counter sums, associativity-independent histogram
+quantiles, aligned monotone trace timestamps, and a deterministically injected
+straggler named by the skew scoreboard. Set ``HEAT_TPU_TELEMETRY_TEST_OUT`` to
+a directory to keep the shards + merged artifacts (the CI job uploads them and
+re-runs the ``python -m heat_tpu.telemetry merge --check`` CLI over them).
 """
 
+import glob
+import json
 import os
+import shutil
 import socket
 import subprocess
 import sys
@@ -17,6 +28,9 @@ import sys
 import pytest
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_mp_worker.py")
+_TELEMETRY_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "_mp_telemetry_worker.py"
+)
 
 
 def _free_port() -> int:
@@ -25,7 +39,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(nprocs: int, devices_per_proc: int, tmpdir: str):
+def _launch(nprocs: int, devices_per_proc: int, tmpdir: str, worker: str = _WORKER):
     coordinator = f"localhost:{_free_port()}"
     env = dict(os.environ)
     env.update(
@@ -41,7 +55,7 @@ def _launch(nprocs: int, devices_per_proc: int, tmpdir: str):
     handles = [open(log, "w") for log in logs]
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, coordinator, str(nprocs), str(i), tmpdir],
+            [sys.executable, worker, coordinator, str(nprocs), str(i), tmpdir],
             env=env,
             stdout=handles[i],
             stderr=subprocess.STDOUT,
@@ -67,3 +81,93 @@ def test_multiprocess_spmd(nprocs, devices_per_proc, tmp_path):
     for i, (rc, out) in enumerate(outs):
         assert rc == 0, f"worker {i} failed (rc={rc}):\n{out[-4000:]}"
         assert f"WORKER_OK {i}" in out, f"worker {i} incomplete:\n{out[-4000:]}"
+
+
+@pytest.mark.parametrize("nprocs,devices_per_proc", [(2, 2), (4, 1)])
+def test_multiprocess_telemetry(nprocs, devices_per_proc, tmp_path):
+    """The ISSUE-11 acceptance shape: an N-process job yields ONE merged
+    report and ONE aligned merged trace, with the injected straggler named."""
+    outs = _launch(nprocs, devices_per_proc, str(tmp_path),
+                   worker=_TELEMETRY_WORKER)
+    for i, (rc, out) in enumerate(outs):
+        assert rc == 0, f"worker {i} failed (rc={rc}):\n{out[-4000:]}"
+        assert f"TELEMETRY_OK {i}" in out, f"worker {i} incomplete:\n{out[-4000:]}"
+
+    from heat_tpu.core import profiler, telemetry
+
+    shard_dir = os.path.join(str(tmp_path), "shards")
+    shards = telemetry.load_shards(shard_dir)
+    assert len(shards) == nprocs, os.listdir(shard_dir)
+    merged = telemetry.merge(shards)
+
+    # --- exact counter sums across processes ------------------------------
+    assert merged["processes"] == nprocs
+    assert merged["counters"]["mp.marker"] == sum(range(1, nprocs + 1))
+    assert merged["clock"]["aligned"] is True
+    assert len(merged["clock"]["anchors_monotonic_ns"]) == nprocs
+
+    # --- histogram quantiles independent of merge associativity ----------
+    hist = merged["histograms"]["mp.lat"]
+    assert hist["count"] == 4 * nprocs
+    reversed_hist = telemetry.merge(list(reversed(shards)))["histograms"]["mp.lat"]
+    assert hist["buckets"] == reversed_hist["buckets"]
+    for q in ("p50_s", "p95_s", "p99_s"):
+        assert hist[q] == reversed_hist[q]
+    # and equal to folding the per-process snapshots by hand, pairwise
+    folded = None
+    for shard in shards:
+        h = profiler.Histogram.from_snapshot(
+            shard["diagnostics"]["profiler"]["histograms"]["mp.lat"]
+        )
+        folded = h if folded is None else folded.merge(h)
+    assert folded.snapshot()["buckets"] == hist["buckets"]
+
+    # --- the injected straggler is named by the scoreboard ----------------
+    straggler = nprocs - 1
+    skew = merged["skew"]
+    assert skew["collectives_measured"] > 0
+    assert skew["slowest_rank"] == straggler, skew["scoreboard"]
+    site = skew["sites"]["comm.shard"]
+    assert site["slowest_rank"] == straggler, site
+    # the retried injected timeout stretches the enter skew to ~0.6 s
+    assert site["max_skew_us"] >= 200_000, site
+    assert f"skew.{'shard'}" in merged["histograms"]
+    board = skew["scoreboard"][str(straggler)]
+    assert board["worst_site"] == "comm.shard"
+
+    # --- merged trace: per-process pid ranges, aligned monotone ts --------
+    trace = telemetry.merged_trace(shards)
+    events = trace["traceEvents"]
+    stride = telemetry.PID_STRIDE
+    pids_seen = set()
+    last = {}
+    for ev in events:
+        proc_slot = ev["pid"] // stride
+        assert 1 <= proc_slot <= nprocs, ev
+        pids_seen.add(proc_slot)
+        if "ts" in ev:
+            assert ev["ts"] >= 0.0, ev
+        if ev.get("ph") in ("B", "E"):
+            key = (ev["pid"], ev["tid"])
+            assert ev["ts"] >= last.get(key, -1.0), ev
+            last[key] = ev["ts"]
+    assert pids_seen == set(range(1, nprocs + 1))
+    # flow arrows exist linking collectives across the process tracks
+    flows = [ev for ev in events if ev.get("cat") == "collective-skew"]
+    assert flows and {ev["ph"] for ev in flows} >= {"s", "f"}
+
+    # --- flight recorder: the straggler's fault firings left a post-mortem -
+    dumps = glob.glob(os.path.join(str(tmp_path), "flight", "*.json"))
+    assert dumps, "no flight-recorder dump from the injected faults"
+    with open(dumps[0]) as f:
+        assert json.load(f)["schema"] == telemetry.FLIGHT_SCHEMA
+
+    # --- keep the artifacts for CI upload + the CLI merge gate ------------
+    keep = os.environ.get("HEAT_TPU_TELEMETRY_TEST_OUT")
+    if keep:
+        dest = os.path.join(keep, f"n{nprocs}")
+        os.makedirs(os.path.join(dest, "shards"), exist_ok=True)
+        for path in glob.glob(os.path.join(shard_dir, "telemetry-shard-*.json")):
+            shutil.copy(path, os.path.join(dest, "shards"))
+        telemetry.write_report(merged, os.path.join(dest, "merged-report.json"))
+        telemetry.write_trace(trace, os.path.join(dest, "merged-trace.json"))

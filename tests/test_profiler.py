@@ -177,6 +177,68 @@ class TestHistogram(_ProfTestCase):
         self.assertEqual(sorted(h.buckets), [0, profiler.Histogram.MAX_INDEX])
 
 
+class TestHistogramDelta(_ProfTestCase):
+    """Windowed snapshots (ISSUE 11): ``delta(prev_snapshot)`` yields the
+    interval histogram between two cumulative dumps, and merge/delta
+    round-trip exactly."""
+
+    def test_delta_counts_only_the_window(self):
+        rng = np.random.default_rng(3)
+        first = np.exp(rng.normal(-6.0, 0.8, size=2_000))
+        second = np.exp(rng.normal(-4.0, 0.5, size=1_500))
+        h = profiler.Histogram()
+        for v in first:
+            h.observe(float(v))
+        snap = json.loads(json.dumps(h.snapshot()))  # a dump's JSON round-trip
+        for v in second:
+            h.observe(float(v))
+        window = h.delta(snap)
+        self.assertEqual(window.count, len(second))
+        # interval quantiles reflect ONLY the window's distribution
+        ref = profiler.Histogram()
+        for v in second:
+            ref.observe(float(v))
+        self.assertEqual(window.buckets, ref.buckets)
+        for q in (0.5, 0.99):
+            exact = float(np.quantile(second, q))
+            self.assertLessEqual(abs(window.percentile(q) - exact) / exact, 0.08)
+
+    def test_merge_delta_roundtrip_associativity(self):
+        rng = np.random.default_rng(4)
+        h = profiler.Histogram()
+        for v in np.exp(rng.normal(-5.0, 1.0, size=1_000)):
+            h.observe(float(v))
+        snap = h.snapshot()
+        for v in np.exp(rng.normal(-5.0, 1.0, size=700)):
+            h.observe(float(v))
+        window = h.delta(snap)
+        rebuilt = profiler.Histogram.from_snapshot(snap).merge(window)
+        self.assertEqual(rebuilt.buckets, h.buckets)
+        self.assertEqual(rebuilt.count, h.count)
+        self.assertAlmostEqual(rebuilt.sum_s, h.sum_s, places=6)
+        for q in (0.5, 0.95, 0.99):
+            self.assertEqual(rebuilt.percentile(q), h.percentile(q))
+
+    def test_delta_accepts_histogram_and_empty_window(self):
+        h = profiler.Histogram()
+        h.observe(0.01)
+        prev = profiler.Histogram.from_snapshot(h.snapshot())
+        window = h.delta(prev)  # nothing happened between the dumps
+        self.assertEqual(window.count, 0)
+        self.assertIsNone(window.percentile(0.5))
+
+    def test_delta_rejects_non_prefix_and_mismatched_config(self):
+        a = profiler.Histogram()
+        a.observe(0.01)
+        b = profiler.Histogram()
+        b.observe(10.0)
+        b.observe(20.0)
+        with self.assertRaises(ValueError):
+            a.delta(b.snapshot())  # different stream: buckets go negative
+        with self.assertRaises(ValueError):
+            a.delta(profiler.Histogram(growth=1.5))
+
+
 class TestTraceExport(_ProfTestCase):
     def test_trace_schema_and_tracks(self):
         _executor.clear_executor_cache()
