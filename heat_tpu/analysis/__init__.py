@@ -1,11 +1,18 @@
 """``ht.analysis`` — the framework invariant checker.
 
-A stdlib-only, AST-driven static analysis over the whole ``heat_tpu`` package
-that turns the prose invariants the codebase already states — the padded
-layout's "pads always hold zero" contract, HLO byte-parity when telemetry is
-idle, the stdlib-only-at-load bootstrap contract, the locked-vs-relaxed
-thread-safety policy in ``diagnostics.py``, and the donation contracts in
-``sanitation.py`` — into blocking, mechanically-enforced rules. See
+A stdlib-only static analysis over the whole ``heat_tpu`` package that turns
+the prose invariants the codebase already states — the padded layout's "pads
+always hold zero" contract, HLO byte-parity when telemetry is idle, the
+stdlib-only-at-load bootstrap contract, the locked-vs-relaxed thread-safety
+policy in ``diagnostics.py``, and the donation contracts in
+``sanitation.py`` — into blocking, mechanically-enforced rules. Since PR 12
+it is a *dataflow engine* (``dataflow.py``: package-wide call graph,
+per-function collective-emission summaries, rank taint) carrying the
+interprocedural rule families: collective-ordering / SPMD-divergence
+(``rules_spmd``: rank-dependent control flow around collectives — the
+multi-controller deadlock class; runtime twin in ``telemetry merge
+--check``) and split/layout contracts (``rules_layout`` against the
+machine-readable ``layout_contracts.py`` registry). See
 ``doc/source/static_analysis.rst`` for the rule catalogue and the origin of
 each invariant.
 

@@ -1,0 +1,481 @@
+"""Interprocedural dataflow for the SPMD and layout rule families.
+
+:class:`~.engine.Universe` gives the checker one parsed AST per module and
+conservative cross-module call resolution; this module grows that into a
+dataflow engine — the substrate ``rules_spmd`` and ``rules_layout`` share:
+
+- **Call graph.** Every function definition in the package becomes a node
+  (keyed ``module:qualname``); edges come from :meth:`Universe.resolve_call`
+  (same-module names, ``module_alias.fn``, ``self.method``) so summaries can
+  propagate interprocedurally. Unresolvable calls are deliberate holes — the
+  analysis is conservative: what it cannot see contributes nothing, so every
+  finding it DOES report is grounded in code it actually resolved.
+
+- **Collective-site detection.** PAPER.md §0 makes every framework op "a
+  local op plus collectives keyed off ``split``", and the framework funnels
+  every collective / layout invocation through the single
+  ``MeshCommunication._guarded`` chokepoint — which makes the site alphabet
+  enumerable: the ``comm.*`` collective methods (``psum`` … ``shard``), the
+  ``_pad_reshard`` jitted reshard, the ``jax.lax`` collectives (confined to
+  ``communication.py`` and the pragma'd axis-name kernels), and the host-side
+  ``multihost_utils`` barriers/gathers. :func:`collective_site` maps a call
+  AST to its canonical site name or ``None``.
+
+- **Emission summaries.** Per function, the *ordered sequence of collective
+  sites* its body may emit, with resolved package calls expanded to their own
+  summaries (fixpoint; recursion contributes nothing but sets the
+  ``cyclic`` flag, and sequences are capped at :data:`MAX_SEQ` sites with a
+  truncation marker so pathological fan-out cannot blow up the checker).
+
+- **Rank taint.** Values derived from the per-process identity —
+  ``jax.process_index()``, ``comm.rank`` / ``comm.process_rank``,
+  ``io._is_writer()`` and friends — are *rank-tainted*: a branch taken on
+  such a value runs differently on different ranks, and any collective whose
+  execution depends on it is the classic multi-controller deadlock
+  (one rank enters the collective, its peers never do; the merge-side twin is
+  ``telemetry merge --check``'s sequence gate). Taint propagates through
+  local assignments (forward pass, iterated for loops) and, via a call-graph
+  fixpoint, through functions whose *return value* is tainted
+  (``_is_writer`` → ``process_index() == 0``).
+
+- **Split flow.** For the layout rules: per function, the layout each local
+  value was given (``v = comm.shard(x, S)`` records ``v ↦ S``), every
+  ``DNDarray(...)`` / ``wrap_result(...)`` construction with its claimed
+  split expression, and the *pad-taint* state — values computed FROM a padded
+  physical operand (``.parray`` fed through an unknown op) whose pad slots
+  may hold garbage until a sanctioned re-mask (``_zero_pads`` /
+  ``_pad_mask`` / the ``_padded_reduce_value`` helpers) cleans them.
+
+Everything is stdlib-only, like the rest of the checker.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .engine import ModuleIndex, Universe, dotted_chain
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: cap on an expanded emission sequence; past it the summary carries the
+#: truncation marker and comparisons treat the tail as unknown
+MAX_SEQ = 64
+
+#: the truncation / unknown-tail marker inside an emission sequence
+ELLIPSIS = "…"
+
+# --------------------------------------------------------------------------
+# collective-site alphabet
+
+#: method names that are collectives on ANY receiver (no other object in the
+#: tree shares them)
+_UNAMBIGUOUS_COMM_METHODS = frozenset({
+    "psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "ring_shift", "exscan", "pshuffle", "psum_scatter",
+    "Allreduce", "Allgather", "Alltoall", "Bcast", "Exscan",
+})
+
+#: method names that are collectives only on a communicator-shaped receiver
+#: (``gather``/``reduce``/``scan``/``shard``… are common verbs elsewhere)
+_AMBIGUOUS_COMM_METHODS = frozenset({
+    "shard", "broadcast", "reduce", "gather", "scatter", "scan",
+    "Reduce", "Gather", "Scatter", "Scan",
+})
+
+#: jax.lax collectives (the donation-rule set plus ragged_all_to_all); these
+#: are confined to communication.py / pragma'd kernels by
+#: ``collective-uncontracted``, but they still emit on the wire and matter
+#: for sequence divergence
+_LAX_COLLECTIVES = frozenset({
+    "psum", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "pshuffle", "psum_scatter", "ragged_all_to_all",
+})
+
+#: host-side cross-process synchronisation (jax.experimental.multihost_utils
+#: + the distributed coordination client): not XLA collectives, but every
+#: process must reach them — a rank-guarded barrier hangs exactly like a
+#: rank-guarded all-reduce
+_MULTIHOST_CALLS = frozenset({
+    "sync_global_devices", "process_allgather", "broadcast_one_to_all",
+    "wait_at_barrier",
+})
+
+
+def _receiver_is_comm(chain: Tuple[str, ...]) -> bool:
+    """Whether the receiver of ``chain[-1]`` looks like a communicator:
+    ``comm.shard`` / ``use_comm.shard`` / ``x.comm.shard`` /
+    ``self.__comm.shard`` / ``COMM_WORLD.shard``."""
+    if len(chain) < 2:
+        return False
+    recv = chain[-2]
+    return "comm" in recv.lower()
+
+
+def collective_site(mod: ModuleIndex, call: ast.Call) -> Optional[str]:
+    """The canonical site name of a collective/layout/barrier call, or None.
+
+    ``comm.<op>`` for MeshCommunication methods (matching the telemetry site
+    names the runtime twin records), ``lax.<op>`` for raw jax.lax
+    collectives, ``multihost.<fn>`` for host-side barriers/gathers,
+    ``comm.reshard`` for ``_pad_reshard``."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id == "_pad_reshard":
+            return "comm.reshard"
+        if func.id == "_guarded" and call.args:
+            site = call.args[0]
+            if isinstance(site, ast.Constant) and isinstance(site.value, str):
+                return site.value
+        if func.id in _MULTIHOST_CALLS:
+            return f"multihost.{func.id}"
+        return None
+    chain = dotted_chain(func)
+    if chain is None:
+        # non-name receiver (e.g. ``get_comm().psum``): match by method name
+        if isinstance(func, ast.Attribute) and func.attr in _UNAMBIGUOUS_COMM_METHODS:
+            return f"comm.{func.attr}"
+        return None
+    name = chain[-1]
+    if len(chain) >= 2 and chain[-2] == "lax":
+        return f"lax.{name}" if name in _LAX_COLLECTIVES else None
+    if name in _MULTIHOST_CALLS:
+        return f"multihost.{name}"
+    if name in _UNAMBIGUOUS_COMM_METHODS:
+        return f"comm.{name}"
+    if name in _AMBIGUOUS_COMM_METHODS and _receiver_is_comm(chain):
+        return f"comm.{name}"
+    return None
+
+
+# --------------------------------------------------------------------------
+# rank-taint sources
+
+#: call names whose RESULT is per-rank identity wherever they resolve
+_TAINT_CALLS = frozenset({
+    "process_index", "process_info", "_is_writer", "is_writer",
+})
+
+#: attribute reads that are per-rank identity
+_TAINT_ATTRS_ALWAYS = frozenset({"process_rank"})
+#: ``rank`` only taints on a communicator-shaped receiver (``comm.rank``,
+#: ``self.rank`` inside communication.py) — "rank" is too common a word
+_TAINT_ATTR_RANK = "rank"
+
+
+def _expr_names(expr: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+# --------------------------------------------------------------------------
+# function table / call graph
+
+
+class FuncInfo:
+    """One function definition: identity, AST, and its computed summaries."""
+
+    __slots__ = (
+        "module", "qualname", "node", "local_calls",
+        "seq", "cyclic", "may_emit", "returns_tainted", "tainted_names",
+    )
+
+    def __init__(self, module: str, qualname: str, node: ast.AST):
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.local_calls: List[ast.Call] = []
+        self.seq: Optional[Tuple[str, ...]] = None
+        self.cyclic = False
+        self.may_emit = False
+        self.returns_tainted = False
+        self.tainted_names: Set[str] = set()
+
+    @property
+    def key(self) -> str:
+        return f"{self.module}:{self.qualname}"
+
+
+class Dataflow:
+    """The shared dataflow state for one :class:`Universe`. Build once via
+    :func:`get` — rules_spmd and rules_layout both work off the same
+    instance."""
+
+    def __init__(self, uni: Universe):
+        self.uni = uni
+        self.functions: Dict[Tuple[str, int], FuncInfo] = {}
+        self._by_def: Dict[int, FuncInfo] = {}
+        self._index_functions()
+        self._compute_taint()
+        self._compute_sequences()
+
+    # -- function table ------------------------------------------------------
+    def _index_functions(self) -> None:
+        for mod in self.uni.modules.values():
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, _FUNC_NODES):
+                    continue
+                cls = mod.class_of.get(node)
+                qual = f"{cls}.{node.name}" if cls else node.name
+                info = FuncInfo(mod.name, qual, node)
+                self.functions[(mod.name, id(node))] = info
+                self._by_def[id(node)] = info
+
+    def info_for(self, fn: ast.AST) -> Optional[FuncInfo]:
+        return self._by_def.get(id(fn))
+
+    def lookup(self, module: str, qualname: str) -> List[FuncInfo]:
+        return [
+            info for info in self.functions.values()
+            if info.module == module and info.qualname == qualname
+        ]
+
+    def callees(self, mod: ModuleIndex, call: ast.Call) -> List[FuncInfo]:
+        """Resolved package-internal callees of one call site."""
+        out = []
+        for tmod, tfn in self.uni.resolve_call(mod, call):
+            info = self._by_def.get(id(tfn))
+            if info is not None:
+                out.append(info)
+        return out
+
+    def edges(self) -> Iterable[Tuple[str, str]]:
+        """The call-graph edge list (``module:qualname`` pairs) — for tests
+        and for the cache's summary section."""
+        for info in self.functions.values():
+            mod = self.uni.modules[info.module]
+            for node in self._walk_own(info.node):
+                if isinstance(node, ast.Call):
+                    for callee in self.callees(mod, node):
+                        yield (info.key, callee.key)
+
+    # -- ordered own-body walk ----------------------------------------------
+    def _walk_own(self, fn: ast.AST) -> Iterable[ast.AST]:
+        """Walk a function body in source order WITHOUT descending into
+        nested defs (their bodies summarize separately and contribute via
+        call edges when invoked)."""
+        stack: List[ast.AST] = list(reversed(list(ast.iter_child_nodes(fn))))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, _FUNC_NODES):
+                continue
+            stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+    # -- rank taint ----------------------------------------------------------
+    def _is_taint_source(self, mod: ModuleIndex, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            chain = dotted_chain(expr.func)
+            name = chain[-1] if chain else (
+                expr.func.attr if isinstance(expr.func, ast.Attribute) else None
+            )
+            if name in _TAINT_CALLS:
+                return True
+            if isinstance(expr.func, ast.Name) or chain is not None:
+                for callee in self.callees(mod, expr):
+                    if callee.returns_tainted:
+                        return True
+            return False
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _TAINT_ATTRS_ALWAYS:
+                return True
+            if expr.attr == _TAINT_ATTR_RANK:
+                chain = dotted_chain(expr)
+                if chain is not None and len(chain) >= 2:
+                    recv = chain[-2]
+                    if "comm" in recv.lower():
+                        return True
+                    if chain[0] == "self" and mod.name.endswith("communication"):
+                        return True
+            return False
+        return False
+
+    def expr_tainted(self, mod: ModuleIndex, info: FuncInfo, expr: ast.AST) -> bool:
+        """Whether ``expr`` (inside ``info``'s body) carries rank identity:
+        it contains a taint source or reads a rank-tainted local name."""
+        for node in ast.walk(expr):
+            if self._is_taint_source(mod, node):
+                return True
+            if isinstance(node, ast.Name) and node.id in info.tainted_names:
+                return True
+        return False
+
+    def _taint_pass(self, mod: ModuleIndex, info: FuncInfo) -> bool:
+        """One forward propagation pass; returns True when anything changed."""
+        changed = False
+        for node in self._walk_own(info.node):
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AugAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                targets, value = [node.target], node.iter
+            elif isinstance(node, ast.NamedExpr):
+                targets, value = [node.target], node.value
+            if value is None or not targets:
+                continue
+            if not self.expr_tainted(mod, info, value):
+                continue
+            for tgt in targets:
+                for name in _expr_names(tgt):
+                    if name not in info.tainted_names:
+                        info.tainted_names.add(name)
+                        changed = True
+        return changed
+
+    def _returns_tainted(self, mod: ModuleIndex, info: FuncInfo) -> bool:
+        for node in self._walk_own(info.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if self.expr_tainted(mod, info, node.value):
+                    return True
+        return False
+
+    def _compute_taint(self) -> None:
+        # local fixpoint per function, then a global fixpoint so functions
+        # returning rank identity (``_is_writer``) taint their callers. Runs
+        # to CONVERGENCE: propagation is monotone (flags only ever flip on),
+        # so each non-final round flips at least one ``returns_tainted`` and
+        # the round count is bounded by the function count — a fixed small
+        # cap would make findings depend on source definition order.
+        for _ in range(len(self.functions) + 1):
+            changed = False
+            for info in self.functions.values():
+                mod = self.uni.modules[info.module]
+                while self._taint_pass(mod, info):
+                    changed = True
+                rt = self._returns_tainted(mod, info)
+                if rt and not info.returns_tainted:
+                    info.returns_tainted = True
+                    changed = True
+            if not changed:
+                break
+
+    # -- emission sequences --------------------------------------------------
+    def node_seq(self, mod: ModuleIndex, info: FuncInfo, root: ast.AST,
+                 ) -> Tuple[Tuple[str, ...], bool]:
+        """The ordered collective sequence emitted by ``root`` (a statement or
+        expression inside ``info``), with resolved calls expanded. Returns
+        ``(sequence, exact)`` — ``exact`` is False when recursion or the
+        length cap truncated the expansion."""
+        seq: List[str] = []
+        exact = True
+        nodes = [root] if not isinstance(root, list) else root
+        for top in nodes:
+            for node in self._iter_with_root(top):
+                if not isinstance(node, ast.Call):
+                    continue
+                site = collective_site(mod, node)
+                if site is not None:
+                    seq.append(site)
+                    continue
+                for callee in self.callees(mod, node):
+                    sub = callee.seq or ()
+                    seq.extend(sub)
+                    if callee.cyclic or ELLIPSIS in sub:
+                        exact = False
+                if len(seq) > MAX_SEQ:
+                    return tuple(seq[:MAX_SEQ]) + (ELLIPSIS,), False
+        out = tuple(s for s in seq if s != ELLIPSIS)
+        if len(out) != len(seq):
+            exact = False
+        return out, exact
+
+    def _iter_with_root(self, root: ast.AST) -> Iterable[ast.AST]:
+        yield root
+        if isinstance(root, _FUNC_NODES):
+            return
+        stack = list(reversed(list(ast.iter_child_nodes(root))))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, _FUNC_NODES):
+                continue
+            stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+    def _compute_sequences(self) -> None:
+        # memoized DFS with an on-stack set: recursion contributes nothing
+        # but poisons the summary as inexact (cyclic)
+        state: Dict[str, int] = {}  # key-id -> 0 visiting, 1 done
+
+        def visit(info: FuncInfo) -> Tuple[str, ...]:
+            key = info.key + f"@{id(info.node)}"
+            st = state.get(key)
+            if st == 1:
+                return info.seq or ()
+            if st == 0:
+                info.cyclic = True
+                return ()
+            state[key] = 0
+            mod = self.uni.modules[info.module]
+            seq: List[str] = []
+            for node in self._walk_own(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                site = collective_site(mod, node)
+                if site is not None:
+                    seq.append(site)
+                else:
+                    for callee in self.callees(mod, node):
+                        sub = visit(callee)
+                        seq.extend(sub)
+                        if callee.cyclic:
+                            info.cyclic = True
+                if len(seq) > MAX_SEQ:
+                    seq = seq[:MAX_SEQ] + [ELLIPSIS]
+                    break
+            info.seq = tuple(seq)
+            info.may_emit = bool(seq)
+            state[key] = 1
+            return info.seq
+
+        for info in self.functions.values():
+            visit(info)
+        # may_emit closure: a cyclic function whose cycle partners emit
+        for _ in range(2):
+            changed = False
+            for info in self.functions.values():
+                if info.may_emit:
+                    continue
+                mod = self.uni.modules[info.module]
+                for node in self._walk_own(info.node):
+                    if isinstance(node, ast.Call) and any(
+                        c.may_emit for c in self.callees(mod, node)
+                    ):
+                        info.may_emit = True
+                        changed = True
+                        break
+            if not changed:
+                break
+
+    # -- serializable summaries (the cache's per-module section) -------------
+    def module_summaries(self) -> Dict[str, Dict[str, dict]]:
+        """``{rel_path: {qualname: {seq, cyclic, returns_tainted}}}`` — the
+        per-module summary payload the incremental cache stores (and the
+        summary-stability tests compare)."""
+        out: Dict[str, Dict[str, dict]] = {}
+        for info in sorted(self.functions.values(),
+                           key=lambda i: (i.module, i.qualname,
+                                          getattr(i.node, "lineno", 0))):
+            mod = self.uni.modules[info.module]
+            entry = out.setdefault(mod.rel_path, {})
+            name = info.qualname
+            if name in entry:  # overloads: disambiguate by line
+                name = f"{info.qualname}@{getattr(info.node, 'lineno', 0)}"
+            entry[name] = {
+                "seq": list(info.seq or ()),
+                "cyclic": info.cyclic,
+                "returns_tainted": info.returns_tainted,
+            }
+        return out
+
+
+def get(uni: Universe) -> Dataflow:
+    """The memoized :class:`Dataflow` for this universe (rules share it)."""
+    df = getattr(uni, "_ht_dataflow", None)
+    if df is None:
+        df = Dataflow(uni)
+        uni._ht_dataflow = df  # type: ignore[attr-defined]
+    return df

@@ -1,0 +1,148 @@
+"""Machine-readable split/layout contracts for the dispatch layer and the
+L5/L6 call sites.
+
+Every entry transcribes a contract the code already states in prose — the
+``origin`` field cites where — into a form ``rules_layout`` can verify with
+its abstract split interpreter. Change the code's contract, change the entry,
+or the checker blocks the PR (the same transcription discipline as
+``rules_locks.LOCK_POLICY``).
+
+Entry schema (keyed ``module:qualname``):
+
+- ``result_split``: the allowed *claimed-split expressions* (normalized
+  source text) a returned ``DNDarray(...)`` / ``wrap_result(...)``
+  construction in this function may carry. The verifier collects every
+  returned construction and checks its split argument against this set —
+  catching "the code resharded to one layout but the wrapper claims
+  another".
+- ``returns: "padded-physical"``: the function deliberately returns a padded
+  physical value whose pad slots are NOT zero (sort sentinels, raw network
+  output); callers own the re-mask. Marks the function exempt from
+  ``layout-pad-mask-dropped`` and documents the hand-off.
+- ``pads: "handled"``: the function computes on padded physical operands but
+  re-masks through a *local* helper or in-program slice the interpreter
+  cannot see through; the exemption is the transcription of the docstring
+  that says so.
+- ``origin``: the prose source of the contract (docstring / doc section).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+CONTRACTS: Dict[str, dict] = {
+    # ---------------------------------------------------------------- L3: dispatch
+    "heat_tpu.core._operations:wrap_result": {
+        "result_split": ["split"],
+        "origin": "wrap_result docstring: wraps a raw jax value with the "
+                  "normalised split it was laid out with (comm.shard(value, "
+                  "split) immediately above the construction)",
+    },
+    "heat_tpu.core._operations:binary_op": {
+        "result_split": ["out_split"],
+        "origin": "__binary_op reference semantics: the dominant-operand "
+                  "split rule (_out_split_binary) defines the output split",
+    },
+    "heat_tpu.core._operations:_binary_jit": {
+        "result_split": ["out_split"],
+        "origin": "staged form of binary_op: same dominant-operand contract, "
+                  "out-sharding applied by the program itself",
+    },
+    "heat_tpu.core._operations:local_op": {
+        "result_split": ["x.split"],
+        "origin": "__local_op docstring: elementwise, no communication — the "
+                  "input split is preserved",
+    },
+    "heat_tpu.core._operations:_local_jit": {
+        "result_split": ["rsplit"],
+        "pads": "handled",
+        "origin": "staged local op: the build() probe normalises an "
+                  "out-of-range split to None (prog.meta carries the "
+                  "result); pads are re-masked INSIDE the traced body "
+                  "(_zero_pads in the fast path, the logical slice + "
+                  "_pad_physical epilogue otherwise) — the executor-program "
+                  "call boundary is opaque to the interpreter",
+    },
+    "heat_tpu.core._operations:reduce_op": {
+        "result_split": ["out_split"],
+        "origin": "__reduce_op docstring: split bookkeeping via "
+                  "_out_split_reduce (axis covering the split reduces to "
+                  "None; earlier axes shift it)",
+    },
+    "heat_tpu.core._operations:_reduce_jit": {
+        "result_split": ["fsplit"],
+        "pads": "handled",
+        "origin": "staged reduction: prog.meta carries the final split the "
+                  "build() probe normalised; pad slots are neutral-element "
+                  "masked (_padded_reduce_value) or sliced logical inside "
+                  "the traced body",
+    },
+    "heat_tpu.core._operations:_padded_reduce": {
+        "result_split": ["final_split"],
+        "origin": "_padded_reduce docstring: the value half returns "
+                  "(value, out_shape, final_split); the caller lays out with "
+                  "exactly that split",
+    },
+    "heat_tpu.core._operations:cum_op": {
+        "result_split": ["x.split"],
+        "origin": "__cum_op docstring: one jnp call along the axis, split "
+                  "unchanged",
+    },
+    "heat_tpu.core._operations:_cum_jit": {
+        "result_split": ["split"],
+        "pads": "handled",
+        "origin": "staged cumulative op: split unchanged (the local `split` "
+                  "is unpacked from x.split), pads re-zeroed inside the "
+                  "traced body (_zero_pads / _pad_physical epilogues)",
+    },
+    # ---------------------------------------------------------------- L5/L6
+    "heat_tpu.core.dist_sort:distributed_sort": {
+        "returns": "padded-physical",
+        "origin": "distributed_sort docstring: returns (values, indices) in "
+                  "padded physical form with SORT SENTINELS past logical_n — "
+                  "callers re-mask (manipulations.sort routes through "
+                  "_zero_pads before wrapping)",
+    },
+    "heat_tpu.core.signal:convolve": {
+        "result_split": ["split"],
+        "origin": "convolve: the result rides the first operand's split "
+                  "(split = a.split, laid out by comm.shard right above)",
+    },
+    "heat_tpu.core.manipulations:sort": {
+        "result_split": ["a.split"],
+        "origin": "sort docstring: padded-physical in, padded-physical out "
+                  "along the same split; sentinels re-zeroed via _zero_pads "
+                  "before wrapping",
+    },
+    "heat_tpu.core.factories:_wrap": {
+        "result_split": ["split"],
+        "origin": "factories' wrap helper: split sanitized against the value "
+                  "shape, then comm.shard(value, split) right above the "
+                  "construction",
+    },
+    "heat_tpu.core.random:_wrap": {
+        "result_split": ["split"],
+        "origin": "random's wrap helper: comm.shard(value, split) right "
+                  "above the construction",
+    },
+    "heat_tpu.core.linalg.svd:_wrap": {
+        "result_split": ["split"],
+        "origin": "svd's wrap helper: A.comm.shard(value, split) inside the "
+                  "construction",
+    },
+    "heat_tpu.core.linalg.basics:_wrap_like": {
+        "result_split": ["split"],
+        "origin": "linalg wrap helper: comm.shard(value, split) immediately "
+                  "above the construction",
+    },
+}
+
+
+def contract_for(module: str, qualname: str) -> dict:
+    """The contract entry for ``module:qualname`` (empty dict when none)."""
+    return CONTRACTS.get(f"{module}:{qualname}", {})
+
+
+def pad_exempt(module: str, qualname: str) -> bool:
+    c = contract_for(module, qualname)
+    return c.get("returns") == "padded-physical" or c.get("pads") == "handled"

@@ -2,9 +2,73 @@
 
 from __future__ import annotations
 
-from . import rules_donation, rules_fallbacks, rules_imports, rules_locks, rules_purity
+from . import (
+    rules_donation,
+    rules_fallbacks,
+    rules_imports,
+    rules_layout,
+    rules_locks,
+    rules_purity,
+    rules_spmd,
+)
 
 RULES = {
+    "spmd-divergent-collective": (
+        "A conditional, loop bound, or early return/raise controlled by a "
+        "rank-tainted value (jax.process_index() / comm.rank / _is_writer() "
+        "and everything assigned from them) makes the emitted collective "
+        "sequence differ across ranks — one rank enters a collective its "
+        "peers never reach and every process blocks inside XLA forever. "
+        "Classic MPI deadlock detection adapted to the mesh-collective "
+        "world; the runtime twin is `telemetry merge --check`'s cross-rank "
+        "sequence gate. Restructure rank-symmetrically: guard only the "
+        "host-local work and let every rank reach the collective (the "
+        "io._serialized_shard_write shape)."
+    ),
+    "spmd-collective-in-except": (
+        "A collective (or a call that transitively emits one) inside an "
+        "except handler: exceptions are per-process, so ranks whose peers "
+        "did not raise never enter the handler's collective and the job "
+        "hangs. Move the collective out of the handler, or make the "
+        "failure rank-symmetric first (e.g. allgather the error state)."
+    ),
+    "layout-shard-claim-mismatch": (
+        "A value laid out via comm.shard(v, S1) is wrapped in a DNDarray "
+        "claiming split=S2 (both statically known, different): the metadata "
+        "lies about the physical layout, so every downstream chunk/lshape/"
+        "collective decision keyed off split is wrong. Make the claimed "
+        "split the one the value was actually laid out with."
+    ),
+    "layout-resplit-roundtrip": (
+        "The same value resharded to two different splits inside one "
+        "function: each hop is a full cross-device reshard and the "
+        "intermediate layout pads/trims the wrong axis for padded physical "
+        "values. The padded-physical contract routes layout changes through "
+        "ONE comm.shard to the final split."
+    ),
+    "layout-pad-mask-dropped": (
+        "A value computed from a padded physical operand (.parray through "
+        "an op the checker cannot prove pad-preserving) is wrapped or laid "
+        "out without a sanctioned re-mask (_zero_pads / _padded_reduce_"
+        "value): pad slots may hold garbage, breaking the 'pads always "
+        "hold zero' invariant that guards like jnp.isnan(x.parray).any() "
+        "rely on. Re-mask, or declare the padded-physical hand-off in "
+        "analysis/layout_contracts.py."
+    ),
+    "layout-contract": (
+        "A returned DNDarray/wrap_result construction claims a split that "
+        "is not among the allowed forms declared for the function in "
+        "analysis/layout_contracts.py (the machine-readable registry "
+        "transcribed from the dispatch docstrings). Change the code's "
+        "contract and the registry together, or the checker blocks — that "
+        "is the point."
+    ),
+    "layout-contract-stale": (
+        "A layout_contracts.py entry names a function that no longer "
+        "exists: the contract outlived the code. Move the entry with the "
+        "refactor or delete it — a dangling contract checks nothing and "
+        "gives false confidence."
+    ),
     "trace-env-read": (
         "No os.environ/os.getenv reads inside traced bodies. A traced body "
         "runs once per compile; an env value read there is frozen into the "
@@ -106,6 +170,8 @@ RULE_RUNNERS = [
     rules_imports.run,
     rules_fallbacks.run,
     rules_donation.run,
+    rules_spmd.run,
+    rules_layout.run,
 ]
 
 

@@ -1,0 +1,426 @@
+"""Split/layout contract verifier.
+
+PAPER.md §0 makes every framework op "a local op plus collectives keyed off
+``split``", and the padded-physical contract (pads always hold zero, re-masked
+inside the producing program) is what keeps ragged compute O(n/P). Both
+invariants live in the split bookkeeping of the four ``_operations`` dispatch
+wrappers and the L5/L6 call sites — exactly the logic the multi-axis
+``PartitionSpec`` refactor on the ROADMAP will rewrite. These rules pin it
+down with a small abstract interpreter over each function body
+(:func:`split_flow`): the layout each local value was *given*
+(``v = comm.shard(x, S)``), every ``DNDarray(...)`` / ``wrap_result(...)``
+construction with the split it *claims*, and the pad-taint state of values
+computed from padded physical operands.
+
+- ``layout-shard-claim-mismatch`` — a value laid out as ``comm.shard(v, S1)``
+  is wrapped in a ``DNDarray`` claiming split ``S2`` where both are statically
+  known (literals) and differ: "the code resharded to None but the result
+  claims split=0". The metadata lies about the physical layout and every
+  downstream chunk/lshape computation is wrong.
+- ``layout-resplit-roundtrip`` — the same value resharded twice to different
+  literal splits inside one function: each hop is a full cross-device
+  reshard, and for padded physicals the intermediate layout pads/trims on the
+  wrong axis. The padded-physical contract routes layout changes through ONE
+  ``comm.shard`` to the final split.
+- ``layout-pad-mask-dropped`` — a value computed FROM a padded physical
+  operand (``.parray`` fed through an op the checker cannot prove
+  pad-preserving) flows into a ``DNDarray`` / ``wrap_result`` /
+  ``comm.shard`` without a sanctioned re-mask (``_zero_pads`` / the
+  ``_padded_reduce_value`` family): pad slots would hold garbage, breaking
+  every guard that probes ``parray`` directly (``jnp.isnan(x.parray).any()``)
+  and the "pads always hold zero" invariant. Functions whose contract
+  declares ``returns: padded-physical`` (e.g. ``distributed_sort``) are the
+  documented hand-offs and exempt.
+- ``layout-contract`` — a returned construction's claimed split is not among
+  the allowed forms declared for that function in
+  :mod:`.layout_contracts` (the machine-readable registry seeded from the
+  dispatch docstrings).
+- ``layout-contract-stale`` — a registry entry names a function that no
+  longer exists: the contract outlived the code; move it with the refactor
+  or delete it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import dataflow, layout_contracts
+from .engine import Finding, ModuleIndex, Universe
+
+CONTRACTS_PATH = "heat_tpu/analysis/layout_contracts.py"
+
+#: calls whose result is pad-safe even with padded-physical arguments: they
+#: re-mask, slice to logical extent, only lay out (zeros in, zeros out), or
+#: read metadata
+_PAD_SAFE_CALLS = frozenset({
+    "_zero_pads", "_pad_mask", "_pad_physical", "_padded_reduce_value",
+    "_padded_reduce", "_lslice", "_replicated", "astype", "_safe_astype",
+    "shard", "device_put", "eval_shape", "ShapeDtypeStruct", "operand_sig",
+    "len", "tuple", "isinstance", "issubdtype", "_is_padded", "any", "all",
+    "iinfo", "finfo", "dtype",
+})
+
+
+def _norm(expr: Optional[ast.AST]) -> Optional[str]:
+    if expr is None:
+        return None
+    try:
+        return " ".join(ast.unparse(expr).split())
+    except Exception:  # ht: ignore[silent-except] -- unparse of synthetic/exotic nodes: treated as statically unknown, never a crash
+        return None
+
+
+def _is_literal_split(norm: Optional[str]) -> bool:
+    if norm is None:
+        return False
+    if norm == "None":
+        return True
+    try:
+        int(norm)
+        return True
+    except ValueError:
+        return False
+
+
+def _ctor_kind(call: ast.Call) -> Optional[str]:
+    """'dndarray' / 'wrap_result' when this call constructs a wrapped array."""
+    func = call.func
+    name = None
+    if isinstance(func, ast.Name):
+        name = func.id
+    elif isinstance(func, ast.Attribute):
+        name = func.attr
+    if name == "DNDarray":
+        return "dndarray"
+    if name == "wrap_result":
+        return "wrap_result"
+    return None
+
+
+def _ctor_args(call: ast.Call, kind: str) -> Tuple[Optional[ast.AST], Optional[ast.AST]]:
+    """``(value_arg, split_arg)`` of a construction call."""
+    split = None
+    for kw in call.keywords:
+        if kw.arg == "split":
+            split = kw.value
+    if kind == "dndarray":
+        value = call.args[0] if call.args else None
+        if split is None and len(call.args) >= 4:
+            split = call.args[3]
+    else:  # wrap_result(value, proto, split)
+        value = call.args[0] if call.args else None
+        if split is None and len(call.args) >= 3:
+            split = call.args[2]
+    return value, split
+
+
+def _shard_args(call: ast.Call) -> Tuple[Optional[ast.AST], Optional[ast.AST]]:
+    value = call.args[0] if call.args else None
+    split = call.args[1] if len(call.args) >= 2 else None
+    if split is None:
+        for kw in call.keywords:
+            if kw.arg == "split":
+                split = kw.value
+    return value, split
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+#: attribute reads ON a parray that are metadata, not data — ``x.parray.dtype``
+#: never carries pad slots anywhere
+_PARRAY_META = frozenset({"dtype", "shape", "ndim", "size", "sharding", "nbytes"})
+
+
+def _contains_parray(expr: ast.AST) -> bool:
+    """Whether ``expr`` reads padded physical DATA (``x.parray``), ignoring
+    pure metadata reads (``x.parray.dtype`` / ``.shape`` / …)."""
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _PARRAY_META and isinstance(expr.value, ast.Attribute) \
+                and expr.value.attr == "parray":
+            return False
+        if expr.attr == "parray":
+            return True
+    return any(_contains_parray(c) for c in ast.iter_child_nodes(expr))
+
+
+class SplitFlow:
+    """The per-function abstract state the layout rules check."""
+
+    def __init__(self) -> None:
+        #: name -> (normalized split expr, the comm.shard call node)
+        self.var_layout: Dict[str, Tuple[Optional[str], ast.Call]] = {}
+        #: construction calls: (call, kind, value_arg, split_norm)
+        self.constructions: List[Tuple[ast.Call, str, Optional[ast.AST], Optional[str]]] = []
+        #: resplit round-trips found at visit time: (call, desc, prev, cur)
+        self.roundtrips: List[Tuple[ast.Call, str, str, str]] = []
+        #: names ALIASING a padded physical value (``p = x.parray``): pads
+        #: are zero there — wrapping them is fine, COMPUTING on them is the
+        #: hazard the pad_tainted set tracks
+        self.parray_names: Set[str] = set()
+        #: names whose value may carry garbage pad slots
+        self.pad_tainted: Set[str] = set()
+        #: pad-taint flows into constructions/shards: (call, kind)
+        self.pad_flows: List[Tuple[ast.Call, str]] = []
+        #: name -> claimed split of the construction assigned to it
+        self.var_ctor_split: Dict[str, Optional[str]] = {}
+        #: name -> claimed split at the moment the name got its layout
+        self.mismatches: List[Tuple[ast.Call, str, str, str, str]] = []
+        #: returned claimed splits: (node, split_norm)
+        self.returned: List[Tuple[ast.AST, Optional[str]]] = []
+
+
+def _target_names(targets) -> List[str]:
+    """Bound names of assignment targets, descending into tuple/list
+    unpacking (``v, shp, fs = ...``)."""
+    names: List[str] = []
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            stack.append(t.value)
+    return names
+
+
+#: expression nodes that COMPUTE a new value from their operands — a padded
+#: physical fed through one produces garbage in the pad slots
+_COMPUTE_NODES = (ast.BinOp, ast.UnaryOp, ast.BoolOp, ast.Compare, ast.IfExp)
+
+
+def _is_bare_parray(expr: ast.AST, parray_names: Set[str]) -> bool:
+    """A direct padded-physical VALUE (no compute applied): ``x.parray`` or a
+    name aliasing one."""
+    if isinstance(expr, ast.Attribute) and expr.attr == "parray":
+        return True
+    return isinstance(expr, ast.Name) and expr.id in parray_names
+
+
+def split_flow(df: "dataflow.Dataflow", mod: ModuleIndex,
+               info: "dataflow.FuncInfo") -> SplitFlow:
+    """Run the abstract split interpreter over one function body (statement
+    order; layout state is checked at visit time so reassignments see the
+    layout a name had WHEN it was consumed, not the end-of-function state)."""
+    flow = SplitFlow()
+
+    def _parrayish(sub: ast.AST) -> bool:
+        """The subexpression carries padded-physical data or pad garbage: a
+        ``.parray`` read, an alias of one, or an already-tainted name."""
+        if _contains_parray(sub):
+            return True
+        return any(
+            isinstance(n, ast.Name)
+            and (n.id in flow.pad_tainted or n.id in flow.parray_names)
+            for n in ast.walk(sub)
+        )
+
+    def expr_pad_tainted(expr: ast.AST) -> bool:
+        """An expression whose value may carry garbage pads: a read of a
+        pad-tainted name, a non-safe call fed a padded physical (directly,
+        or through an alias), or an operator compute (``x.parray + 1``) on
+        one."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in flow.pad_tainted:
+                return True
+            if isinstance(node, _COMPUTE_NODES):
+                operands = list(ast.iter_child_nodes(node))
+                if any(_parrayish(op) for op in operands):
+                    return True
+            if isinstance(node, ast.Call):
+                cname = _call_name(node)
+                if cname in _PAD_SAFE_CALLS:
+                    continue
+                if dataflow.collective_site(mod, node) is not None:
+                    continue  # comm layout ops preserve zero pads
+                for sub in list(node.args) + [kw.value for kw in node.keywords]:
+                    if _parrayish(sub):
+                        return True
+        return False
+
+    def check_shard_value(call: ast.Call, value: Optional[ast.AST],
+                          split_norm: Optional[str]) -> None:
+        """Visit-time checks on one comm.shard call: nested and chained
+        resplit round-trips, pad-tainted values laid out unmasked."""
+        if isinstance(value, ast.Call) \
+                and dataflow.collective_site(mod, value) == "comm.shard":
+            _, inner_split = _shard_args(value)
+            a, b = _norm(inner_split), split_norm
+            if _is_literal_split(a) and _is_literal_split(b) and a != b:
+                flow.roundtrips.append((call, "nested", a, b))
+        if isinstance(value, ast.Name):
+            laid = flow.var_layout.get(value.id)
+            if laid is not None and laid[1] is not call:
+                prev = laid[0]
+                if _is_literal_split(prev) and _is_literal_split(split_norm) \
+                        and prev != split_norm:
+                    flow.roundtrips.append((call, value.id, prev, split_norm))
+            if value.id in flow.pad_tainted:
+                flow.pad_flows.append((call, "comm.shard"))
+
+    def record_call(call: ast.Call) -> None:
+        kind = _ctor_kind(call)
+        if kind is not None:
+            value, split = _ctor_args(call, kind)
+            claimed = _norm(split)
+            flow.constructions.append((call, kind, value, claimed))
+            if value is not None and expr_pad_tainted(value):
+                flow.pad_flows.append((call, kind))
+            if isinstance(value, ast.Name):
+                laid = flow.var_layout.get(value.id)
+                if laid is not None and claimed is not None \
+                        and laid[0] is not None and claimed != laid[0] \
+                        and _is_literal_split(claimed) \
+                        and _is_literal_split(laid[0]):
+                    flow.mismatches.append(
+                        (call, kind, value.id, laid[0], claimed)
+                    )
+            return
+        if dataflow.collective_site(mod, call) == "comm.shard":
+            value, split = _shard_args(call)
+            check_shard_value(call, value, _norm(split))
+
+    for node in df._walk_own(info.node):
+        if isinstance(node, ast.Call):
+            record_call(node)
+            continue
+        if isinstance(node, ast.Return) and node.value is not None:
+            val = node.value
+            targets = val.elts if isinstance(val, ast.Tuple) else [val]
+            for t in targets:
+                if isinstance(t, ast.Call) and _ctor_kind(t):
+                    kind = _ctor_kind(t)
+                    _, split = _ctor_args(t, kind)
+                    flow.returned.append((t, _norm(split)))
+                elif isinstance(t, ast.Name) and t.id in flow.var_ctor_split:
+                    flow.returned.append((t, flow.var_ctor_split[t.id]))
+            continue
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        value = getattr(node, "value", None)
+        if value is None:
+            continue
+        names = _target_names(targets)
+        if isinstance(value, ast.Call):
+            site = dataflow.collective_site(mod, value)
+            kind = _ctor_kind(value)
+            if site == "comm.shard":
+                # arg checks against the PRE-assignment state (record_call
+                # re-visits the node later, deduped by call identity)
+                varg, vsplit = _shard_args(value)
+                check_shard_value(value, varg, _norm(vsplit))
+                for name in names:
+                    flow.var_layout[name] = (_norm(vsplit), value)
+                    flow.pad_tainted.discard(name)
+                    flow.parray_names.discard(name)
+                continue
+            if kind is not None:
+                _, split = _ctor_args(value, kind)
+                for name in names:
+                    flow.var_ctor_split[name] = _norm(split)
+                    flow.pad_tainted.discard(name)
+                    flow.parray_names.discard(name)
+                continue
+            if _call_name(value) in _PAD_SAFE_CALLS:
+                for name in names:
+                    flow.pad_tainted.discard(name)
+                    flow.parray_names.discard(name)
+                    flow.var_layout.pop(name, None)
+                continue
+        if _is_bare_parray(value, flow.parray_names):
+            # aliasing, not compute: pads are still zero, but computes ON
+            # the alias must taint exactly like computes on x.parray
+            flow.parray_names.update(names)
+            for name in names:
+                flow.pad_tainted.discard(name)
+                flow.var_layout.pop(name, None)
+        elif expr_pad_tainted(value):
+            flow.pad_tainted.update(names)
+            for name in names:
+                flow.var_layout.pop(name, None)
+                flow.parray_names.discard(name)
+        else:
+            for name in names:
+                flow.pad_tainted.discard(name)
+                flow.parray_names.discard(name)
+                if not isinstance(value, ast.Name):
+                    flow.var_layout.pop(name, None)
+    return flow
+
+
+def run(uni: Universe) -> List[Finding]:
+    df = dataflow.get(uni)
+    out: List[Finding] = []
+    seen_contract_keys: Set[str] = set()
+    for info in df.functions.values():
+        mod = uni.modules[info.module]
+        contract = layout_contracts.contract_for(info.module, info.qualname)
+        if contract:
+            seen_contract_keys.add(f"{info.module}:{info.qualname}")
+        flow = split_flow(df, mod, info)
+        for call, kind, name, laid, claimed in flow.mismatches:
+            out.append(mod.finding(
+                "layout-shard-claim-mismatch", call,
+                f"{info.qualname!r} lays {name!r} out as comm.shard(..., "
+                f"{laid}) but the {kind} construction claims split="
+                f"{claimed}: the metadata lies about the physical layout",
+            ))
+        seen_rt: Set[int] = set()
+        for call, desc, prev, cur in flow.roundtrips:
+            if id(call) in seen_rt:
+                continue
+            seen_rt.add(id(call))
+            what = "in one expression" if desc == "nested" else f"of {desc!r}"
+            out.append(mod.finding(
+                "layout-resplit-roundtrip", call,
+                f"{info.qualname!r} reshards {what} from split={prev} to "
+                f"split={cur}: a resplit round-trip the padded-physical "
+                "contract forbids — lay out once, at the final split",
+            ))
+        if not layout_contracts.pad_exempt(info.module, info.qualname):
+            seen_pf: Set[int] = set()
+            for call, kind in flow.pad_flows:
+                if id(call) in seen_pf:
+                    continue
+                seen_pf.add(id(call))
+                out.append(mod.finding(
+                    "layout-pad-mask-dropped", call,
+                    f"{info.qualname!r} wraps a value computed from a padded "
+                    f"physical operand (.parray) in {kind} without "
+                    "re-masking: pad slots may hold garbage — route through "
+                    "_zero_pads (or declare the padded-physical hand-off in "
+                    "layout_contracts)",
+                ))
+        allowed = contract.get("result_split")
+        if allowed:
+            for node, claimed in flow.returned:
+                if claimed is not None and claimed not in allowed:
+                    out.append(mod.finding(
+                        "layout-contract", node,
+                        f"{info.qualname!r} returns a construction claiming "
+                        f"split={claimed}, but its declared contract allows "
+                        f"only {sorted(allowed)} (layout_contracts: "
+                        f"{contract.get('origin', 'no origin recorded')})",
+                    ))
+    for key in sorted(set(layout_contracts.CONTRACTS) - seen_contract_keys):
+        # staleness is judged per MODULE actually scanned: a contract whose
+        # whole module is outside this universe (fixture trees, --root runs
+        # over a subtree) is out of scope, not stale
+        if key.split(":", 1)[0] not in uni.modules:
+            continue
+        out.append(Finding(
+            "layout-contract-stale", CONTRACTS_PATH, 0,
+            f"layout contract {key!r} matches no function — the contract "
+            "outlived the code; move it with the refactor or delete it",
+            key,
+        ))
+    return out

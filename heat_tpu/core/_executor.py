@@ -2061,7 +2061,7 @@ def _force_sync_locked(roots: Tuple[Deferred, ...],
             ):
                 raise
             try:
-                outs = _plan_replay_eager(pl)
+                outs = _plan_replay_eager(pl)  # ht: ignore[spmd-collective-in-except] -- deliberate recovery path: compile/execute failures are deterministic functions of (program, operand avals), identical on every SPMD controller, so peers fail and replay the same eager collective sequence in step; a genuinely rank-local fault is surfaced by the resilience plan/flight recorder instead of riding this path
             except resilience.DeadlineExceeded:
                 _get_scheduler().note_lifecycle(
                     "deadline_expired", _tenant_or_none()
@@ -2236,7 +2236,7 @@ def _force_async(roots: Tuple[Deferred, ...],
                     fail(exc)
                     return
                 try:
-                    outs = _plan_replay_eager(pl)
+                    outs = _plan_replay_eager(pl)  # ht: ignore[spmd-collective-in-except] -- deliberate recovery path (see _force_sync_locked): dispatch failures are deterministic across SPMD controllers, so every rank's queued execution fails and replays the same sequence; the async queue is per-process host-side state and adds no cross-rank ordering
                 except resilience.DeadlineExceeded as dexc:
                     sched.note_lifecycle("deadline_expired", tenant)
                     fail(dexc)

@@ -48,7 +48,14 @@ mergeable for exactly this. This module closes that gap with three pillars:
   and the rank that entered last is the straggler. The merged report carries
   ``skew.<op>`` histograms, a per-rank straggler scoreboard naming the
   slowest rank, and the merged trace draws flow arrows linking the same
-  collective across process tracks (worst skews first).
+  collective across process tracks (worst skews first). The same identity
+  also powers the **sequence-consistency gate** (the runtime twin of the
+  static ``spmd-divergent-collective`` rule in :mod:`heat_tpu.analysis`):
+  every rank's per-tag ordered site list must match the lowest rank's, and
+  ``merge --check`` fails on the first divergence naming the rank, the
+  index, and the expected/actual sites — the signature a rank-dependent
+  branch around a collective produces. Needs no clock alignment (local
+  ordering only), so it works even when the handshake degraded.
 
 - **Flight recorder.** An always-on bounded ring of the last
   ``HEAT_TPU_FLIGHT_EVENTS`` lifecycle / resilience / fallback events per
@@ -93,6 +100,11 @@ Env knobs
   (the ring still records; on-demand dumps still work; read at dump time).
 - ``HEAT_TPU_FLIGHT_EVENTS=N``      — ring capacity (default 512; applied at
   import and re-applied by :func:`reset`).
+- ``HEAT_TPU_TELEMETRY_WINDOWS=N``  — collective-window ring capacity
+  (default 16384; applied at import and by :func:`reset`). An overflowed
+  ring invalidates the cross-rank sequence gate (oldest windows dropped),
+  and ``merge --check`` then FAILS rather than silently passing — long
+  collection runs that need the gate raise this.
 - ``HEAT_TPU_TELEMETRY_HANDSHAKE=0``— skip the clock handshake at bootstrap.
 
 Stdlib-only at module load (like diagnostics/profiler/resilience): the merge
@@ -215,7 +227,19 @@ _clock: Dict[str, Any] = {
 # tenants' requests interleave in a different order per process (the async
 # executor's default shape; a bare per-site counter would pair unrelated
 # collectives across ranks and attribute phantom skew)
-_windows: "deque[tuple]" = deque(maxlen=_MAX_WINDOWS)
+def _windows_capacity() -> int:
+    """Window-ring capacity: ``HEAT_TPU_TELEMETRY_WINDOWS`` (default 16384;
+    applied at import and re-applied by :func:`reset`). An overflowed ring
+    drops its oldest windows, which invalidates the cross-rank sequence
+    gate — long jobs that need the gate raise the cap."""
+    try:
+        return max(256, int(os.environ.get("HEAT_TPU_TELEMETRY_WINDOWS", "")
+                            or _MAX_WINDOWS))
+    except ValueError:
+        return _MAX_WINDOWS
+
+
+_windows: "deque[tuple]" = deque(maxlen=_windows_capacity())
 _site_seq: Dict[Tuple[str, Optional[str]], int] = {}
 _durations: Dict[str, Any] = {}  # site -> profiler.Histogram
 
@@ -280,11 +304,12 @@ def reset() -> None:
     histograms, and the flight ring (the dump ledger and rate-limit state are
     kept — they describe files already on disk). Process identity and the
     clock anchor survive; the collecting switch is untouched. The flight
-    ring is rebuilt at the current ``HEAT_TPU_FLIGHT_EVENTS`` capacity, so
-    an in-process env change takes effect at the next reset."""
-    global _flight
+    ring is rebuilt at the current ``HEAT_TPU_FLIGHT_EVENTS`` capacity and
+    the window ring at ``HEAT_TPU_TELEMETRY_WINDOWS``, so an in-process env
+    change takes effect at the next reset."""
+    global _flight, _windows
     with _lock:
-        _windows.clear()
+        _windows = deque(maxlen=_windows_capacity())
         _site_seq.clear()
         _durations.clear()
         _flight = deque(maxlen=_flight_capacity())
@@ -556,6 +581,7 @@ def shard_payload() -> dict:
         payload["process"] = dict(_process)
         payload["collectives"] = {
             "windows": [list(w) for w in _windows],
+            "windows_cap": _windows.maxlen,
             "durations": {
                 site: h.snapshot() for site, h in sorted(_durations.items())
             },
@@ -796,12 +822,108 @@ def _site_op(site: str) -> str:
     return site.rsplit(".", 1)[-1]
 
 
+_MAX_SEQUENCE_DIVERGENCES = 16
+
+
+def _sequence_check(shards: List[dict]) -> dict:
+    """Cross-rank collective-sequence consistency — the runtime twin of the
+    static ``spmd-divergent-collective`` rule. Every rank's windows, ordered
+    by enter time and grouped by the ambient request tag (SPMD symmetry is
+    per REQUEST: concurrent tenants may interleave differently per process,
+    but one request's guarded calls must be the same ordered site list on
+    every rank), are compared element-wise against the lowest rank. The
+    first mismatch per (tag, rank) is reported with the diverging rank, the
+    index into the sequence, and the expected/actual sites — the exact hang
+    signature a rank-dependent branch around a collective produces. Clock
+    alignment is NOT required: only per-rank local ordering is compared.
+
+    A shard whose bounded window ring overflowed (>= its recorded capacity)
+    dropped its oldest windows, so sequence comparison would report phantom
+    divergence — the check marks itself invalid instead."""
+    if len(shards) < 2:
+        return {
+            "valid": True, "consistent": True, "tags_checked": 0,
+            "windows_checked": 0, "divergences": [],
+        }
+    cap = min(
+        int(s.get("collectives", {}).get("windows_cap") or _MAX_WINDOWS)
+        for s in shards
+    )
+    overflowed = [
+        s["process"]["index"] for s in shards
+        if len(s.get("collectives", {}).get("windows", ())) >= cap
+    ]
+    if overflowed:
+        return {
+            "valid": False,
+            "reason": f"window ring overflowed on rank(s) {overflowed}: "
+                      "oldest windows were dropped, sequences are not "
+                      "comparable (raise HEAT_TPU_TELEMETRY_WINDOWS)",
+            "consistent": True, "tags_checked": 0, "windows_checked": 0,
+            "divergences": [],
+        }
+    per_rank: Dict[int, Dict[Optional[str], List[str]]] = {}
+    windows_checked = 0
+    for shard in shards:
+        idx = shard["process"]["index"]
+        wins = sorted(
+            shard.get("collectives", {}).get("windows", ()),
+            key=lambda w: (w[2], w[1]),
+        )
+        tagmap: Dict[Optional[str], List[str]] = {}
+        for win in wins:
+            tag = win[4] if len(win) > 4 else None
+            tagmap.setdefault(tag, []).append(str(win[0]))
+            windows_checked += 1
+        per_rank[idx] = tagmap
+    ranks = sorted(per_rank)
+    reference = ranks[0]
+    tags = sorted(
+        {t for m in per_rank.values() for t in m},
+        key=lambda t: (t is not None, t or ""),
+    )
+    divergences: List[dict] = []
+    for tag in tags:
+        ref_seq = per_rank[reference].get(tag, [])
+        for rank in ranks[1:]:
+            seq = per_rank[rank].get(tag, [])
+            if seq == ref_seq:
+                continue
+            n = min(len(seq), len(ref_seq))
+            at = next(
+                (i for i in range(n) if seq[i] != ref_seq[i]), n
+            )
+            divergences.append({
+                "tag": tag,
+                "rank": rank,
+                "reference_rank": reference,
+                "index": at,
+                "expected": ref_seq[at] if at < len(ref_seq) else None,
+                "actual": seq[at] if at < len(seq) else None,
+                "expected_len": len(ref_seq),
+                "actual_len": len(seq),
+            })
+            if len(divergences) >= _MAX_SEQUENCE_DIVERGENCES:
+                break
+        if len(divergences) >= _MAX_SEQUENCE_DIVERGENCES:
+            break
+    return {
+        "valid": True,
+        "consistent": not divergences,
+        "tags_checked": len(tags),
+        "windows_checked": windows_checked,
+        "divergences": divergences,
+    }
+
+
 def merge(shards: Union[str, Sequence[dict]]) -> dict:
     """Fold N telemetry shards (a directory or loaded dicts) into ONE global
     report: exact counter sums, folded spans and collective tallies, merged
     latency histograms (the associative bucket fold), summed executor /
     lifecycle stats, cross-rank ``skew.<op>`` histograms with the straggler
-    scoreboard, and per-process breakdowns. Raises ``ValueError`` on zero
+    scoreboard, the collective-sequence consistency section (``sequence``:
+    per-tag ordered site lists compared across ranks, first divergence per
+    rank named), and per-process breakdowns. Raises ``ValueError`` on zero
     shards or inconsistent process counts."""
     shards = _resolve_shards(shards)
     if not shards:
@@ -862,6 +984,7 @@ def merge(shards: Union[str, Sequence[dict]]) -> dict:
             ),
         }
     skew = _compute_skew(shards)
+    sequence = _sequence_check(shards)
     for site, entry in skew["sites"].items():
         if entry.get("histogram") is not None and profiler is not None:
             hists[f"skew.{_site_op(site)}"] = _hist_from(entry["histogram"])
@@ -888,6 +1011,7 @@ def merge(shards: Union[str, Sequence[dict]]) -> dict:
         ),
         "executor": executor,
         "skew": skew,
+        "sequence": sequence,
         "per_process": processes,
     }
     return report
@@ -1065,7 +1189,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="fail unless exactly N shards merged")
     mp.add_argument("--check", action="store_true",
                     help="CI gate: also require one shard per process of the "
-                    "job (a partial collection must not pass as global)")
+                    "job (a partial collection must not pass as global) AND "
+                    "cross-rank collective-sequence consistency — the same "
+                    "ordered site list per request tag on every rank, the "
+                    "runtime twin of the static spmd-divergent-collective "
+                    "rule; a divergence names the first diverging rank/site")
     args = parser.parse_args(argv)
 
     try:
@@ -1097,14 +1225,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         write_trace(trace, args.trace_out)
         print(f"merged trace  -> {args.trace_out}")
     skew = report["skew"]
+    sequence = report["sequence"]
     print(json.dumps({
         "shards": len(shards),
         "aligned": report["clock"]["aligned"],
         "counters": len(report["counters"]),
         "histograms": len(report["histograms"]),
         "collectives_measured": skew["collectives_measured"],
+        # an invalid gate must never read as an affirmative "consistent"
+        "sequence_consistent": sequence["consistent"] if sequence["valid"] else None,
+        "sequence_valid": sequence["valid"],
         "slowest_rank": skew["slowest_rank"],
     }, sort_keys=True))
+    if args.check and not sequence["valid"]:
+        # a gate that cannot check must not pass as a gate that checked
+        print(
+            "telemetry merge FAILED: collective-sequence gate could not "
+            f"run: {sequence.get('reason', 'unknown')}"
+        )
+        return 1
+    if args.check and sequence["valid"] and not sequence["consistent"]:
+        d = sequence["divergences"][0]
+        site = d["actual"] or d["expected"]
+        have = d["actual"] or "(sequence ended)"
+        want = d["expected"] or "(sequence ended)"
+        print(
+            "telemetry merge FAILED: cross-rank collective-sequence "
+            f"divergence: rank {d['rank']} diverges from rank "
+            f"{d['reference_rank']} at {site} "
+            f"(tag={d['tag']!r}, index {d['index']}: expected {want}, "
+            f"got {have}; lengths {d['expected_len']} vs {d['actual_len']}) "
+            "— a rank-dependent branch issued a different collective "
+            "sequence; this job would hang on a real mesh"
+        )
+        return 1
     return 0
 
 

@@ -1,0 +1,66 @@
+"""Multi-controller worker that deliberately DIVERGES its collective
+sequence: the classic SPMD bug the static ``spmd-divergent-collective`` rule
+and the ``telemetry merge --check`` sequence gate exist to catch.
+
+Launched by tests/test_multiprocess.py with
+``python _mp_divergence_worker.py <coordinator> <num_processes> <process_id>
+<tmpdir>``. Every process runs the same three guarded ``comm.shard`` rounds
+(coordination barriers keep them in step), then the LAST rank takes a
+rank-dependent branch and issues ONE extra guarded ``comm.shard`` its peers
+never reach — on a real mesh with compute collectives this is the hang; here
+the guarded telemetry windows record the asymmetry, each process dumps its
+shard, and the parent asserts ``python -m heat_tpu.telemetry merge --check``
+fails naming the diverging rank and site. Prints ``DIVERGENCE_OK <pid>``.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, nprocs, pid, tmpdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ["HEAT_TPU_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["HEAT_TPU_NUM_PROCESSES"] = str(nprocs)
+    os.environ["HEAT_TPU_PROCESS_ID"] = str(pid)
+
+    import numpy as np
+
+    import heat_tpu as ht  # noqa: F401 - the import runs the bootstrap
+    import jax
+    from heat_tpu.core import telemetry
+    from heat_tpu.core.communication import COMM_WORLD
+
+    client = jax._src.distributed.global_state.client
+
+    def barrier(name: str) -> None:
+        client.wait_at_barrier(f"ht_mp_divergence_{name}", 60_000)
+
+    telemetry.enable()
+
+    g = np.arange(nprocs * 4 * 2, dtype=np.float32).reshape(nprocs * 4, 2)
+    for r in range(3):
+        barrier(f"round{r}")
+        x = COMM_WORLD.shard(g + r, 0)
+        del x
+
+    # the divergence: a rank-dependent branch around a guarded layout op —
+    # sequence [shard, shard, shard, shard] on this rank vs [shard x3] on
+    # its peers. (No cross-process XLA compute: make_array_from_callback only
+    # builds addressable shards, so the CPU backend completes and the
+    # telemetry merge can demonstrate the divergence instead of hanging.)
+    if pid == nprocs - 1:
+        extra = COMM_WORLD.shard(g * 3.0, 0)
+        del extra
+
+    barrier("pre-dump")
+    out = telemetry.dump_shard(os.path.join(tmpdir, "shards"))
+    assert os.path.exists(out)
+    print(f"DIVERGENCE_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,9 @@ a directory to keep the shards + merged artifacts (the CI job uploads them and
 re-runs the ``python -m heat_tpu.telemetry merge --check`` CLI over them).
 """
 
+import contextlib
 import glob
+import io
 import json
 import os
 import shutil
@@ -30,6 +32,9 @@ import pytest
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_mp_worker.py")
 _TELEMETRY_WORKER = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "_mp_telemetry_worker.py"
+)
+_DIVERGENCE_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "_mp_divergence_worker.py"
 )
 
 
@@ -122,6 +127,12 @@ def test_multiprocess_telemetry(nprocs, devices_per_proc, tmp_path):
         folded = h if folded is None else folded.merge(h)
     assert folded.snapshot()["buckets"] == hist["buckets"]
 
+    # --- clean run: the cross-rank collective sequences are consistent ----
+    seq = merged["sequence"]
+    assert seq["valid"] is True, seq
+    assert seq["consistent"] is True, seq["divergences"]
+    assert seq["windows_checked"] > 0
+
     # --- the injected straggler is named by the scoreboard ----------------
     straggler = nprocs - 1
     skew = merged["skew"]
@@ -171,3 +182,52 @@ def test_multiprocess_telemetry(nprocs, devices_per_proc, tmp_path):
             shutil.copy(path, os.path.join(dest, "shards"))
         telemetry.write_report(merged, os.path.join(dest, "merged-report.json"))
         telemetry.write_trace(trace, os.path.join(dest, "merged-trace.json"))
+
+
+def test_multiprocess_sequence_divergence(tmp_path):
+    """The ISSUE-12 acceptance shape: a rank-dependent branch issues one
+    extra guarded collective on the last rank of a 2-process job; the
+    telemetry merge sequence gate must FAIL, naming the rank and the site —
+    the runtime twin of the static ``spmd-divergent-collective`` rule."""
+    nprocs = 2
+    outs = _launch(nprocs, 2, str(tmp_path), worker=_DIVERGENCE_WORKER)
+    for i, (rc, out) in enumerate(outs):
+        assert rc == 0, f"worker {i} failed (rc={rc}):\n{out[-4000:]}"
+        assert f"DIVERGENCE_OK {i}" in out, f"worker {i} incomplete:\n{out[-4000:]}"
+
+    from heat_tpu.core import telemetry
+
+    shard_dir = os.path.join(str(tmp_path), "shards")
+    shards = telemetry.load_shards(shard_dir)
+    assert len(shards) == nprocs
+
+    # merge() reports the divergence precisely…
+    merged = telemetry.merge(shards)
+    seq = merged["sequence"]
+    assert seq["valid"] is True
+    assert seq["consistent"] is False, seq
+    d = seq["divergences"][0]
+    assert d["rank"] == nprocs - 1
+    assert d["reference_rank"] == 0
+    assert d["actual"] == "comm.shard"
+    assert d["index"] == 3  # three symmetric rounds, the 4th call is extra
+    assert (d["expected_len"], d["actual_len"]) == (3, 4)
+
+    # …and the CI gate (the public CLI surface) fails, naming rank and site
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = telemetry.main(["merge", "--dir", shard_dir,
+                             "--expect", str(nprocs), "--check"])
+    out = buf.getvalue()
+    assert rc == 1, out
+    assert f"rank {nprocs - 1}" in out
+    assert "comm.shard" in out
+    assert "divergence" in out
+
+    # without --check the merge still succeeds (report-only mode)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = telemetry.main(["merge", "--dir", shard_dir,
+                             "--expect", str(nprocs)])
+    assert rc == 0, buf.getvalue()
+    assert '"sequence_consistent": false' in buf.getvalue()

@@ -661,3 +661,181 @@ class TestEnvKnob(_TelTestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+# ----------------------------------------------------------------- sequence gate
+class TestSequenceConsistency(_TelTestCase):
+    """The runtime twin of the static ``spmd-divergent-collective`` rule:
+    ``merge`` compares every rank's per-tag ordered site list against the
+    lowest rank and ``--check`` fails naming the first diverging rank/site."""
+
+    def _win(self, site, seq, t, tag=None):
+        return (site, seq, t, t + 1000, tag)
+
+    def test_consistent_sequences_pass(self):
+        wins = [self._win("comm.shard", i + 1, i * 10_000) for i in range(3)]
+        shards = [
+            _synthetic_shard(0, 2, anchor_ns=0, windows=wins),
+            _synthetic_shard(1, 2, anchor_ns=0, windows=wins),
+        ]
+        seq = telemetry.merge(shards)["sequence"]
+        self.assertTrue(seq["valid"])
+        self.assertTrue(seq["consistent"])
+        self.assertEqual(seq["windows_checked"], 6)
+        self.assertEqual(seq["divergences"], [])
+
+    def test_extra_collective_names_rank_and_site(self):
+        base = [self._win("comm.shard", i + 1, i * 10_000) for i in range(3)]
+        extra = base + [self._win("comm.shard", 4, 40_000)]
+        shards = [
+            _synthetic_shard(0, 2, anchor_ns=0, windows=base),
+            _synthetic_shard(1, 2, anchor_ns=0, windows=extra),
+        ]
+        seq = telemetry.merge(shards)["sequence"]
+        self.assertFalse(seq["consistent"])
+        d = seq["divergences"][0]
+        self.assertEqual(d["rank"], 1)
+        self.assertEqual(d["reference_rank"], 0)
+        self.assertEqual(d["index"], 3)
+        self.assertIsNone(d["expected"])
+        self.assertEqual(d["actual"], "comm.shard")
+        self.assertEqual((d["expected_len"], d["actual_len"]), (3, 4))
+
+    def test_mid_sequence_site_mismatch(self):
+        a = [self._win("comm.shard", 1, 0), self._win("comm.psum", 1, 10_000)]
+        b = [self._win("comm.shard", 1, 0), self._win("comm.all_gather", 1, 10_000)]
+        shards = [
+            _synthetic_shard(0, 2, anchor_ns=0, windows=a),
+            _synthetic_shard(1, 2, anchor_ns=0, windows=b),
+        ]
+        d = telemetry.merge(shards)["sequence"]["divergences"][0]
+        self.assertEqual(d["index"], 1)
+        self.assertEqual(d["expected"], "comm.psum")
+        self.assertEqual(d["actual"], "comm.all_gather")
+
+    def test_tag_keyed_identity_tolerates_tenant_interleaving(self):
+        # tenant A then B on rank 0; B then A on rank 1 — per-tag sequences
+        # are identical, so concurrent tenants interleaving differently per
+        # process must NOT read as divergence (the async executor's default)
+        r0 = [self._win("comm.psum", 1, 0, "A"), self._win("comm.shard", 1, 10_000, "B")]
+        r1 = [self._win("comm.shard", 1, 0, "B"), self._win("comm.psum", 1, 10_000, "A")]
+        shards = [
+            _synthetic_shard(0, 2, anchor_ns=0, windows=r0),
+            _synthetic_shard(1, 2, anchor_ns=0, windows=r1),
+        ]
+        seq = telemetry.merge(shards)["sequence"]
+        self.assertTrue(seq["consistent"], seq["divergences"])
+        self.assertEqual(seq["tags_checked"], 2)
+
+    def test_sequence_checked_even_with_unaligned_clocks(self):
+        # the skew math refuses unaligned clocks; the sequence gate needs
+        # only per-rank LOCAL ordering, so it still detects the divergence
+        shards = [
+            _synthetic_shard(0, 2, anchor_ns=0,
+                             windows=[self._win("comm.shard", 1, 0)]),
+            _synthetic_shard(1, 2, anchor_ns=999,
+                             windows=[self._win("comm.psum", 1, 0)]),
+        ]
+        for s in shards:
+            s["clock"]["aligned"] = False
+        merged = telemetry.merge(shards)
+        self.assertFalse(merged["skew"]["valid"])
+        self.assertFalse(merged["sequence"]["consistent"])
+
+    def test_overflowed_window_ring_invalidates_and_check_fails_loudly(self):
+        import contextlib
+        import io
+
+        wins = [self._win("comm.shard", i + 1, i * 1000) for i in range(3)]
+        shards = [
+            _synthetic_shard(0, 2, anchor_ns=0, windows=wins),
+            _synthetic_shard(1, 2, anchor_ns=0, windows=wins[:2]),
+        ]
+        for s in shards:
+            s["collectives"]["windows_cap"] = 3
+        seq = telemetry.merge(shards)["sequence"]
+        self.assertFalse(seq["valid"])
+        self.assertIn("HEAT_TPU_TELEMETRY_WINDOWS", seq["reason"])
+        self.assertTrue(seq["consistent"])  # no confident phantom divergence
+        # a gate that cannot check must not pass as one that checked: the
+        # CLI --check FAILS, and the summary never affirms consistency
+        d = self._tmp()
+        for s in shards:
+            p = os.path.join(
+                d, f"{telemetry.SHARD_PREFIX}p{s['process']['index']:04d}.json"
+            )
+            with open(p, "w") as f:
+                json.dump(s, f)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = telemetry.main(["merge", "--dir", d, "--check"])
+        out = buf.getvalue()
+        self.assertEqual(rc, 1, out)
+        self.assertIn("could not run", out)
+        self.assertIn('"sequence_consistent": null', out)
+        # report-only mode still merges
+        self.assertEqual(telemetry.main(["merge", "--dir", d]), 0)
+
+    def test_windows_capacity_env_knob_applies_at_reset(self):
+        old = os.environ.get("HEAT_TPU_TELEMETRY_WINDOWS")
+        os.environ["HEAT_TPU_TELEMETRY_WINDOWS"] = "300"
+
+        def restore():
+            if old is None:
+                os.environ.pop("HEAT_TPU_TELEMETRY_WINDOWS", None)
+            else:
+                os.environ["HEAT_TPU_TELEMETRY_WINDOWS"] = old
+            telemetry.reset()
+
+        self.addCleanup(restore)
+        telemetry.reset()
+        self.assertEqual(telemetry._windows.maxlen, 300)
+        payload = telemetry.shard_payload()
+        self.assertEqual(payload["collectives"]["windows_cap"], 300)
+
+    def test_single_shard_trivially_consistent(self):
+        shards = [_synthetic_shard(0, 1, anchor_ns=0,
+                                   windows=[self._win("comm.shard", 1, 0)])]
+        seq = telemetry.merge(shards)["sequence"]
+        self.assertTrue(seq["valid"])
+        self.assertTrue(seq["consistent"])
+
+    def test_cli_check_fails_on_divergence_and_passes_clean(self):
+        import contextlib
+        import io
+
+        base = [self._win("comm.shard", 1, 0)]
+        extra = base + [self._win("comm.ppermute", 1, 5_000)]
+
+        def write_dir(shards):
+            d = self._tmp()
+            for s in shards:
+                path = os.path.join(
+                    d, f"{telemetry.SHARD_PREFIX}p{s['process']['index']:04d}.json"
+                )
+                with open(path, "w") as f:
+                    json.dump(s, f)
+            return d
+
+        clean = write_dir([
+            _synthetic_shard(0, 2, anchor_ns=0, windows=base),
+            _synthetic_shard(1, 2, anchor_ns=0, windows=base),
+        ])
+        self.assertEqual(
+            telemetry.main(["merge", "--dir", clean, "--expect", "2",
+                            "--check"]), 0)
+
+        bad = write_dir([
+            _synthetic_shard(0, 2, anchor_ns=0, windows=base),
+            _synthetic_shard(1, 2, anchor_ns=0, windows=extra),
+        ])
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = telemetry.main(["merge", "--dir", bad, "--expect", "2",
+                                 "--check"])
+        out = buf.getvalue()
+        self.assertEqual(rc, 1, out)
+        self.assertIn("rank 1", out)
+        self.assertIn("comm.ppermute", out)
+        # report-only mode still merges (the gate is --check's)
+        self.assertEqual(telemetry.main(["merge", "--dir", bad]), 0)
