@@ -327,6 +327,44 @@ def _bench_dispatch(devices: int = 8, timeout_s: float = 900.0) -> list:
     return records
 
 
+def _bench_checkpoint(devices: int = 8, timeout_s: float = 900.0) -> list:
+    """Checkpoint save/restore GB/s (``benchmarks/cb/checkpoint_bw.py``) in a
+    hermetic virtual CPU mesh subprocess: v1 single-writer vs v2 parallel
+    chunked saves plus the resharding-restore arm — host-side only, so the
+    state-management trajectory records every round even relay-down."""
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "cb", "checkpoint_bw.py",
+    )
+    baseline = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "cb", "checkpoint_bw_baseline.json",
+    )
+    proc = subprocess.run(
+        [sys.executable, script, "--devices", str(devices),
+         "--baseline", baseline],
+        capture_output=True, text=True, timeout=timeout_s,
+    )
+    records = []
+    for line in proc.stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            records.append(rec)
+    if not records:
+        raise RuntimeError(
+            f"checkpoint bandwidth benchmark produced no records "
+            f"(rc={proc.returncode}): {proc.stderr[-500:]}"
+        )
+    return records
+
+
 def _bench_analysis(timeout_s: float = 600.0) -> dict:
     """Invariant-checker findings count (``python -m heat_tpu.analysis``) as a
     trajectory gauge: 0 means the tree is analysis-clean (new findings, stale
@@ -672,6 +710,10 @@ def main():
         traceback.print_exc(file=sys.stderr)
     try:
         dispatch_extras += _bench_serving()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    try:
+        dispatch_extras += _bench_checkpoint()
     except Exception:
         traceback.print_exc(file=sys.stderr)
     try:

@@ -229,6 +229,7 @@ def _sched_snapshot() -> dict:
         "queue_full_events": s["queue_full_events"],
         "queue_depth_peak": s["queue_depth_peak"],
         "queued_dispatches": s["queued_dispatches"],
+        "drain_rejects": s["drain_rejects"],
         "shed": s["shed_requests"],
         "expired": s["expired_requests"],
         "cancelled": s["cancelled_requests"],
@@ -245,8 +246,8 @@ def _sched_pressure(before: dict, after: dict, tags=None) -> dict:
     middle tag component."""
     out = {
         k: after[k] - before[k]
-        for k in ("queue_full_events", "queued_dispatches", "shed",
-                  "expired", "cancelled")
+        for k in ("queue_full_events", "queued_dispatches", "drain_rejects",
+                  "shed", "expired", "cancelled")
     }
     out["queue_depth_peak"] = after["queue_depth_peak"]
     if tags:

@@ -42,3 +42,4 @@ from . import datasets
 from . import sparse
 from . import nn
 from . import optim
+from . import serving

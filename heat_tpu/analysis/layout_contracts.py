@@ -114,6 +114,24 @@ CONTRACTS: Dict[str, dict] = {
                   "along the same split; sentinels re-zeroed via _zero_pads "
                   "before wrapping",
     },
+    # ------------------------------------------------------------ checkpoint v2
+    "heat_tpu.core.checkpoint:_restore_split_leaf": {
+        "result_split": ["split_ax"],
+        "pads": "handled",
+        "origin": "checkpoint v2 streaming restore: resharding-on-restore is "
+                  "a LEGITIMATE layout transition — the chunk grid is the "
+                  "writer's layout, the returned DNDarray claims the restore "
+                  "template's split_ax, and the physical value is assembled "
+                  "per target shard via make_array_from_single_device_arrays "
+                  "with pad slots zero-filled at block construction "
+                  "(host_block starts from np.zeros)",
+    },
+    "heat_tpu.core.checkpoint:_rebuild_tree": {
+        "result_split": ["split_ax"],
+        "origin": "v1 restore contract: the template tree decides the target "
+                  "distribution — comm.shard(value, split_ax) immediately "
+                  "above the construction",
+    },
     "heat_tpu.core.factories:_wrap": {
         "result_split": ["split"],
         "origin": "factories' wrap helper: split sanitized against the value "

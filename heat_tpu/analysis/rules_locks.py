@@ -140,7 +140,7 @@ CLASS_POLICY: List[ClassPolicy] = [
     # ISSUE 10 lifecycle state (draining flag + shed/cancel/expiry ledger).
     ClassPolicy(_SCHED, "DispatchScheduler", "_cv", {
         "_queues", "_by_key", "_depth", "_active", "_paused", "_thread",
-        "_draining",
+        "_draining", "_drains",
         "queue_depth_peak", "batched_requests", "batch_width_hist",
         "submitted", "inline_runs", "queue_full_events", "drain_rejects",
         "lifecycle", "tenant_lifecycle",

@@ -71,6 +71,7 @@ work lives in the closures the executor puts on the items.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
 import time
@@ -208,6 +209,7 @@ class DispatchScheduler:
         self._active = 0          # executions in flight (inline + thread)
         self._paused = False      # test hook: hold items in the queue
         self._draining = False    # lifecycle: admission closed (drain/shutdown)
+        self._drains = 0          # drain epochs: quiesce must not reopen a later drain
         self._seq = itertools.count(1)
         self._thread: Optional[threading.Thread] = None
         self.batch_runner = batch_runner
@@ -526,6 +528,7 @@ class DispatchScheduler:
         expected caller); use :meth:`reopen` to resume normal service."""
         with self._cv:
             self._draining = True
+            self._drains += 1
             self._paused = False
             self._cv.notify_all()
             flushed = self._cv.wait_for(
@@ -569,6 +572,33 @@ class DispatchScheduler:
         with self._cv:
             self._draining = False
             self._cv.notify_all()
+
+    @contextlib.contextmanager
+    def quiesce(self, timeout: float = 30.0):
+        """Drain, yield a quiesced scheduler for the caller's critical section
+        (model hot-swap rebinds serving state here), and reopen — on a
+        clean flush, on a :class:`~.resilience.DrainTimeout` (whose queued
+        items were already shed with typed errors), and on a body failure
+        alike, so a failed swap can never leave admission closed forever.
+        While quiesced, refused submits execute inline on their caller's
+        thread (``submit`` contract): requests slow down, none are dropped.
+
+        The reopen yields to a DELIBERATE closure: if admission was already
+        closed when quiesce began, or another drain ran during the window
+        (the atexit shutdown drain racing a swap), the scheduler stays
+        closed — reopening it would admit work into a shutting-down loop and
+        strand its futures at interpreter exit."""
+        with self._cv:
+            was_draining = self._draining
+            epoch = self._drains
+        try:
+            self.drain(timeout)  # epoch + 1 (increments before it can raise)
+            yield self
+        finally:
+            with self._cv:
+                if not was_draining and self._drains == epoch + 1:
+                    self._draining = False
+                    self._cv.notify_all()
 
     def draining(self) -> bool:
         with self._cv:

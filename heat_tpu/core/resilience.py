@@ -114,6 +114,7 @@ __all__ = [
     "Shed",
     "RequestCancelled",
     "DrainTimeout",
+    "SwapFailed",
 ]
 
 # Hot-path gates, read as ``resilience._armed`` / ``resilience._active`` by the
@@ -189,6 +190,23 @@ class DrainTimeout(RuntimeError):
             f"scheduler drain did not settle within {timeout_s:.3f}s: "
             f"{len(self.undelivered)} queued item(s) shed with this error "
             f"({names}); {self.in_flight} execution(s) still in flight"
+        )
+
+
+class SwapFailed(RuntimeError):
+    """A zero-downtime model swap (``ht.serving.swap_state``) failed and was
+    rolled back to the previous generation: staging the new state raised
+    (verification/IO — serving was never touched), the drain timed out, or the
+    rebind itself failed. ``stage`` names the step; serving continues on the
+    old generation either way — a failed swap is an incident, never an
+    outage."""
+
+    def __init__(self, stage: str, pool: str, detail: str):
+        self.stage = stage
+        self.pool = pool
+        super().__init__(
+            f"model swap for pool {pool!r} failed at the {stage!r} step and "
+            f"was rolled back: {detail}"
         )
 
 

@@ -271,6 +271,7 @@ _AUTO_DUMP_KINDS = frozenset({
                       # re-scanning a known-bad step must not burn budget)
     "data-loss",      # donated buffer invalidated by a failed call
     "drain-timeout",  # DispatchScheduler.drain could not flush
+    "swap-failed",    # a model hot-swap rolled back (ht.serving.swap_state)
 })
 
 

@@ -1,0 +1,195 @@
+"""``ht.serving`` — zero-downtime model state management for a serving pool.
+
+A serving deployment holds one live *generation* of model state (a pytree of
+DNDarrays — params, biases, codebooks) that every request reads. Upgrading
+that state under load has two failure modes this module closes (ISSUE 13, the
+fourth leg of checkpoint v2):
+
+- **a torn upgrade** — requests observing half-old half-new state. Prevented
+  by staging: the new generation is loaded AND integrity-verified off to the
+  side (the v2 streaming restore), then bound in one atomic reference swap
+  inside a scheduler quiesce window.
+- **dropped requests** — work lost across the swap boundary. Prevented by the
+  scheduler's lifecycle verbs (PR 9): :func:`swap_state` runs
+  ``drain(timeout)`` → rebind → ``reopen()`` through
+  ``DispatchScheduler.quiesce``, during which refused submits execute inline
+  on their caller's thread (slower, never dropped) and a timed-out drain
+  sheds its queue with TYPED errors — so ``admitted + shed + failed ==
+  offered`` holds exactly across the swap, the invariant the swap-under-load
+  chaos gate (``benchmarks/serving/swap_gate.py``) enforces.
+
+Any failure — staging, drain, rebind — rolls back to the old generation and
+raises the typed :class:`~heat_tpu.core.resilience.SwapFailed`; serving
+continues on the old state. Every swap (and every rollback) lands in the
+pool's ledger, the ``lifecycle.swap`` profiler counter track (Perfetto), the
+flight-recorder ring, and — for rollbacks — the always-on resilience event
+stream, where the ``swap-failed`` kind triggers an automatic post-mortem dump.
+
+Thread-safety: ``ModelPool._state`` is a bare attribute rebound atomically
+(CPython reference assignment) inside the quiesce window; request threads
+read it relaxed — they see the complete old or the complete new generation,
+never a mix. The guarantee is per READ: a handler must read ``pool.state``
+once per request and compute against that snapshot — two reads straddling a
+swap would observe two different (each complete) generations. The ledger and
+generation bookkeeping mutate under the pool's ``_lock``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from .core import checkpoint as _checkpoint
+from .core import diagnostics, profiler, resilience, telemetry
+from .core.resilience import SwapFailed
+
+__all__ = ["ModelPool", "SwapFailed", "swap_state"]
+
+
+def _scheduler():
+    from .core import _executor
+
+    return _executor._get_scheduler()
+
+
+class ModelPool:
+    """One served model generation plus its swap bookkeeping.
+
+    ``template`` is the restore template (the pytree shape every generation
+    must match — its DNDarray leaves pin the serving split/comm/device);
+    request handlers read :attr:`state`. ``load`` binds the first generation;
+    :func:`swap_state` upgrades it under load.
+    """
+
+    def __init__(self, template: Any, *, name: str = "model"):
+        self.name = name
+        self._template = template
+        self._state: Any = None
+        self._generation: Optional[str] = None
+        self._lock = threading.Lock()
+        self._ledger: list = []
+        self._swaps = 0
+        self._rollbacks = 0
+
+    @property
+    def state(self) -> Any:
+        """The live generation's state tree (relaxed read: always a complete
+        generation — rebinding happens atomically inside a quiesce window)."""
+        return self._state
+
+    @property
+    def generation(self) -> Optional[str]:
+        """Checkpoint directory of the live generation (None before load)."""
+        return self._generation
+
+    def load(self, directory: str, **kwargs) -> "ModelPool":
+        """Bind the first generation from a checkpoint (streaming restore +
+        verification). Not a swap: nothing is serving yet, so no drain."""
+        staged = _checkpoint.load_checkpoint(self._template, directory, **kwargs)
+        self._rebind(staged, directory)
+        return self
+
+    def _rebind(self, state: Any, generation: Optional[str]) -> None:
+        self._state = state
+        with self._lock:
+            self._generation = generation
+
+    def _note_swap(self, entry: dict) -> None:
+        with self._lock:
+            self._ledger.append(entry)
+            if entry["ok"]:
+                self._swaps += 1
+            else:
+                self._rollbacks += 1
+            total = self._swaps
+
+        if diagnostics._enabled:
+            diagnostics.counter("serving.swap" if entry["ok"] else "serving.swap_rollback")
+        if profiler._active:
+            profiler.record_counter("lifecycle.swap", total)
+        telemetry.flight_record(
+            "lifecycle", "serving.swap",
+            f"pool={self.name} ok={entry['ok']} stage={entry.get('stage', '-')} "
+            f"from={entry['from']} to={entry['to']}",
+            kind="swap" if entry["ok"] else "swap-rollback",
+        )
+
+    def swap_ledger(self) -> list:
+        """Every attempted swap, oldest first: ``{t, ok, from, to, drain_s,
+        total_s}`` plus ``stage``/``error`` for rollbacks."""
+        with self._lock:
+            return [dict(e) for e in self._ledger]
+
+
+def swap_state(
+    pool: ModelPool,
+    new_dir: str,
+    *,
+    drain_timeout_s: float = 30.0,
+    scheduler=None,
+    **load_kwargs,
+) -> dict:
+    """Hot-swap ``pool``'s model state to the generation at ``new_dir`` with
+    zero dropped requests.
+
+    1. **Stage** — load + verify the new generation off to the side (the v2
+       streaming restore; resharding onto the template's layout is allowed).
+       A corrupt or unreadable generation fails HERE, before serving is
+       touched at all.
+    2. **Quiesce** — ``drain(drain_timeout_s)`` the dispatch scheduler:
+       in-flight work retires, queued work flushes (or, past the timeout, is
+       shed with typed errors — counted, never dropped); admission-refused
+       submits run inline on their caller's thread meanwhile.
+    3. **Rebind** — one atomic reference swap of the pool's state.
+    4. **Reopen** — admission resumes (guaranteed by ``quiesce`` even on
+       failure).
+
+    Any error rolls the pool back to the old generation and raises
+    :class:`~heat_tpu.core.resilience.SwapFailed` naming the failed stage;
+    the rollback is recorded as a ``swap-failed`` resilience event (which
+    auto-dumps a flight-recorder post-mortem). Returns the ledger entry of a
+    successful swap."""
+    t0 = time.monotonic()
+    old_state, old_gen = pool._state, pool._generation
+
+    def _fail(stage: str, exc: BaseException) -> "SwapFailed":
+        detail = f"{type(exc).__name__}: {exc}"
+        diagnostics.record_resilience_event(
+            "serving.swap", "swap-failed",
+            f"pool={pool.name} stage={stage} to={new_dir}: {detail}",
+        )
+        pool._note_swap({
+            "t": time.time(), "ok": False, "stage": stage, "from": old_gen,
+            "to": new_dir, "error": detail,
+            "total_s": round(time.monotonic() - t0, 6),
+        })
+        return SwapFailed(stage, pool.name, detail)
+
+    try:
+        staged = _checkpoint.load_checkpoint(pool._template, new_dir, **load_kwargs)
+    except Exception as exc:
+        raise _fail("stage", exc) from exc
+
+    sched = scheduler if scheduler is not None else _scheduler()
+    t_drain = time.monotonic()
+    try:
+        with sched.quiesce(drain_timeout_s):
+            drain_s = time.monotonic() - t_drain
+            pool._rebind(staged, new_dir)
+    except resilience.DrainTimeout as exc:
+        # quiesce reopened admission; the rebind never ran (drain raised
+        # first), but rebind defensively in case a future refactor moves it
+        pool._rebind(old_state, old_gen)
+        raise _fail("drain", exc) from exc
+    except Exception as exc:
+        pool._rebind(old_state, old_gen)
+        raise _fail("rebind", exc) from exc
+
+    entry = {
+        "t": time.time(), "ok": True, "from": old_gen, "to": new_dir,
+        "drain_s": round(drain_s, 6),
+        "total_s": round(time.monotonic() - t0, 6),
+    }
+    pool._note_swap(entry)
+    return dict(entry)

@@ -1,0 +1,132 @@
+"""Multi-controller checkpoint-v2 worker: one SPMD process of an N-process job.
+
+Launched by tests/test_multiprocess.py with
+``python _mp_ckpt_worker.py <coordinator> <num_processes> <process_id> <tmpdir>``.
+Exercises the distributed half of ISSUE 13 that single-process runs cannot:
+
+1. **Parallel chunked save** — every process writes only the chunks of its
+   addressable shards into the shared assembly dir; rank 0 merges the sidecar
+   chunk metadata, commits the manifest last; restore round-trips.
+2. **Writer crash** — rank 0's manifest write is fault-injected: EVERY rank
+   must get an exception (rank 0 the injected fault, the others a typed
+   ``CheckpointWriteFailed`` from the commit agreement) — never a hang.
+3. **Non-writer chunk-write failure** — the last rank's chunk writes fail:
+   the post-write agreement degrades EVERY rank to the serialized v1 path
+   together (rank-symmetric collectives), and the save still commits.
+
+Prints ``CKPT_OK <pid>`` on success; any assertion failure exits non-zero and
+fails the parent test.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, nprocs, pid, tmpdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ["HEAT_TPU_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["HEAT_TPU_NUM_PROCESSES"] = str(nprocs)
+    os.environ["HEAT_TPU_PROCESS_ID"] = str(pid)
+
+    import numpy as np
+
+    import heat_tpu as ht
+    import jax
+    from heat_tpu.core import checkpoint as ck
+    from heat_tpu.core import resilience
+
+    assert jax.process_count() == nprocs
+
+    comm = ht.get_comm()
+    per, cols = 6, 5
+    global_ref = np.arange(nprocs * per * cols, dtype=np.float32).reshape(
+        nprocs * per, cols
+    )
+    # build the cross-host array from the replicated host value: construction
+    # only, like _mp_telemetry_worker — the is_split ingest path allgathers
+    # local shapes via an XLA computation this container's CPU backend cannot
+    # run, and the save path under test only ever reads addressable shards
+    a = ht.array(global_ref, split=0)
+    assert not a.larray.is_fully_addressable
+
+    def assert_matches(arr, ref) -> None:
+        # compare per addressable shard: `.numpy()` on a cross-host array is
+        # an XLA allgather this container's CPU backend cannot run
+        for s in arr.larray.addressable_shards:
+            np.testing.assert_array_equal(np.asarray(s.data), ref[s.index])
+
+    assert_matches(a, global_ref)
+
+    # --- 1. parallel v2 save: per-process chunk writes, one manifest ----------
+    ckpt1 = os.path.join(tmpdir, "ckpt_v2")
+    ht.save_checkpoint({"a": a, "tag": np.int64(41)}, ckpt1)
+    manifest = ck.read_manifest(ckpt1)
+    assert manifest["schema"] == ck.SCHEMA, manifest["schema"]
+    assert manifest["processes"] == nprocs
+    leaf = manifest["leaves"][0]
+    assert leaf["split"] == 0 and leaf["shards"] == comm.size, leaf
+    offs = [c["offset"] for c in leaf["chunks"]]
+    assert offs == ck._expected_offsets(leaf), (offs, leaf)
+    assert ck.verify_checkpoint(ckpt1) == []
+
+    back = ht.load_checkpoint(
+        {"a": ht.zeros(global_ref.shape, split=0), "tag": np.int64(0)}, ckpt1
+    )
+    assert_matches(back["a"], global_ref)
+    assert int(back["tag"]) == 41
+
+    # replicated restore target: full-leaf assembly + shard(None)
+    back_r = ht.load_checkpoint(
+        {"a": ht.zeros(global_ref.shape, split=None), "tag": np.int64(0)}, ckpt1
+    )
+    assert_matches(back_r["a"], global_ref)
+    assert back_r["a"].split is None
+
+    # --- 2. writer crash at the manifest: every rank gets the exception -------
+    ckpt2 = os.path.join(tmpdir, "ckpt_writer_crash")
+    if pid == 0:
+        resilience.arm_fault_plan(
+            [{"site": "checkpoint.manifest", "on_call": 1, "count": 9999,
+              "kind": "raise"}]
+        )
+    crashed = None
+    try:
+        ht.save_checkpoint({"a": a}, ckpt2)
+    except Exception as exc:  # noqa: BLE001 - the assertion IS the type check
+        crashed = exc
+    if pid == 0:
+        resilience.disarm_fault_plan()
+        assert isinstance(crashed, resilience.FaultInjected), crashed
+    else:
+        assert isinstance(crashed, ck.CheckpointWriteFailed), crashed
+    # nothing committed: the directory is not restorable, loudly
+    try:
+        ht.load_checkpoint({"a": ht.zeros(global_ref.shape, split=0)}, ckpt2)
+        raise AssertionError("uncommitted checkpoint restored")
+    except ck.CheckpointCorrupt:
+        pass
+
+    # --- 3. non-writer chunk failure: rank-symmetric degradation to v1 --------
+    ckpt3 = os.path.join(tmpdir, "ckpt_degrade")
+    resilience.reset(clear_breakers=True)
+    if pid == nprocs - 1:
+        resilience.arm_fault_plan(
+            [{"site": "checkpoint.chunk_write", "on_call": 1, "count": 9999,
+              "kind": "raise"}]
+        )
+    ht.save_checkpoint({"a": a}, ckpt3)
+    if pid == nprocs - 1:
+        resilience.disarm_fault_plan()
+    manifest3 = ck.read_manifest(ckpt3)
+    assert manifest3["schema"] == ck.SCHEMA_V1, manifest3["schema"]
+    back3 = ht.load_checkpoint({"a": ht.zeros(global_ref.shape, split=0)}, ckpt3)
+    assert_matches(back3["a"], global_ref)
+
+    print(f"CKPT_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,8 @@
 """Checkpoint/resume tests (SURVEY §5: the reference has only data-level I/O; this is
 the training-state checkpointing the TPU build adds — a native manifest-backed
-atomic format since ISSUE 6, with torn-write detection and policy-driven retry)."""
+atomic format since ISSUE 6, with torn-write detection and policy-driven retry;
+parallel per-chunk writes and resharding-on-restore since ISSUE 13 — see
+tests/test_checkpoint_v2.py for the crash matrix and resharding round-trips)."""
 
 import json
 import os
@@ -131,15 +133,26 @@ class TestCheckpointIntegrity(TestCase):
         manifest = _ckpt.read_manifest(path)
         self.assertEqual(manifest["schema"], _ckpt.SCHEMA)
         self.assertEqual(len(manifest["leaves"]), 1)
-        self.assertEqual(manifest["leaves"][0]["nbytes"], value.nbytes)
+        leaf = manifest["leaves"][0]
+        # v2: the leaf is a chunk set on the canonical comm.chunk grid whose
+        # byte total is exactly the leaf payload
+        self.assertEqual(leaf["split"], 0)
+        self.assertEqual(leaf["shards"], self.comm.size)
+        self.assertEqual(sum(c["nbytes"] for c in leaf["chunks"]), value.nbytes)
+        offs = [c["offset"] for c in leaf["chunks"]]
+        self.assertEqual(offs, sorted(offs))
         self.assertEqual(_ckpt.verify_checkpoint(path), [])
 
+    def _first_chunk(self, path: str) -> str:
+        manifest = _ckpt.read_manifest(path)
+        return os.path.join(path, manifest["leaves"][0]["chunks"][0]["file"])
+
     def test_torn_write_restore_rejects_and_reports(self):
-        # the injected torn-write truncates the committed payload while the
+        # the injected torn-write truncates the committed chunk while the
         # manifest keeps the intended digest — exactly a partial write
         resilience.arm_fault_plan(
-            [{"site": "checkpoint.write", "on_call": 1, "kind": "torn-write",
-              "fraction": 0.5}]
+            [{"site": "checkpoint.chunk_write", "on_call": 1,
+              "kind": "torn-write", "fraction": 0.5}]
         )
         path = self._save("torn", np.arange(32, dtype=np.float32))
         resilience.disarm_fault_plan()
@@ -148,22 +161,62 @@ class TestCheckpointIntegrity(TestCase):
         self.assertIn("torn write", problems[0])
         with self.assertRaises(ht.CheckpointCorrupt) as ctx:
             ht.load_checkpoint({"x": ht.zeros((32,), split=0)}, path)
-        self.assertIn("leaf_0.bin", str(ctx.exception))
+        self.assertIn("leaf_0.c", str(ctx.exception))
         self.assertIn("torn write", str(ctx.exception))
 
     def test_hand_truncated_file_detected(self):
         value = np.arange(16, dtype=np.float32)
         path = self._save("trunc", value)
-        leaf = os.path.join(path, "leaf_0.bin")
+        leaf = self._first_chunk(path)
         with open(leaf, "r+b") as fh:
-            fh.truncate(value.nbytes // 2)
+            fh.truncate(os.path.getsize(leaf) // 2)
         with self.assertRaises(ht.CheckpointCorrupt):
             ht.load_checkpoint({"x": ht.zeros((16,), split=0)}, path)
+
+    def test_incomplete_chunk_grid_is_corrupt_even_unverified(self):
+        """A valid-JSON v2 manifest that LOST a chunk entry must raise typed
+        — with verify=False too — never fill the missing rows from
+        uninitialized memory."""
+        value = np.arange(24, dtype=np.float32).reshape(8, 3)
+        path = self._save("grid", value)
+        mpath = os.path.join(path, _ckpt.MANIFEST_NAME)
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+        if len(manifest["leaves"][0]["chunks"]) < 2:
+            self.skipTest("single-chunk layout at this world size")
+        del manifest["leaves"][0]["chunks"][1]
+        with open(mpath, "w") as fh:
+            json.dump(manifest, fh)
+        problems = _ckpt.verify_checkpoint(path)
+        self.assertTrue(problems and "chunk grid incomplete" in problems[0])
+        for verify in (True, False):
+            with self.assertRaises(ht.CheckpointCorrupt) as ctx:
+                ht.load_checkpoint(
+                    {"x": ht.zeros((8, 3), split=0)}, path, verify=verify
+                )
+            self.assertIn("chunk grid incomplete", str(ctx.exception))
+
+    def test_v1_torn_leaf_is_typed_even_unverified(self):
+        """verify=False keeps the per-read byte-length check on v1 payloads:
+        a truncated leaf raises CheckpointCorrupt, not a numpy shape error."""
+        path = os.path.join(self.tmp, "v1torn")
+        ht.save_checkpoint(
+            {"x": ht.array(np.arange(16, dtype=np.float32), split=0)},
+            path, parallel=False,
+        )
+        leaf = os.path.join(path, _ckpt.read_manifest(path)["leaves"][0]["file"])
+        with open(leaf, "r+b") as fh:
+            fh.truncate(os.path.getsize(leaf) // 2)
+        with self.assertRaises(ht.CheckpointCorrupt) as ctx:
+            ht.load_checkpoint(
+                {"x": ht.zeros((16,), split=0)}, path, verify=False
+            )
+        self.assertIn("torn read", str(ctx.exception))
 
     def test_bitflip_detected_by_digest(self):
         value = np.arange(16, dtype=np.float32)
         path = self._save("flip", value)
-        leaf = os.path.join(path, "leaf_0.bin")
+        leaf = self._first_chunk(path)
         with open(leaf, "r+b") as fh:
             fh.seek(3)
             byte = fh.read(1)
@@ -183,7 +236,8 @@ class TestCheckpointIntegrity(TestCase):
 
     def test_write_fault_retried_under_policy(self):
         resilience.arm_fault_plan(
-            [{"site": "checkpoint.write", "on_call": 1, "count": 2, "kind": "raise"}]
+            [{"site": "checkpoint.chunk_write", "on_call": 1, "count": 2,
+              "kind": "raise"}]
         )
         value = np.arange(12, dtype=np.float32)
         path = self._save("retried", value)  # two injected failures, third lands
@@ -207,9 +261,9 @@ class TestCheckpointIntegrity(TestCase):
             fh.write("{not json")
         self.assertEqual(mgr.all_steps(), [1])
         self.assertEqual(mgr.latest_step, 1)
-        # a torn leaf UNDER an intact manifest still enumerates (cheap scan)
+        # a torn chunk UNDER an intact manifest still enumerates (cheap scan)
         # but refuses the actual restore with the per-file report
-        leaf = os.path.join(self.tmp, "run", "step_1", "leaf_0.bin")
+        leaf = self._first_chunk(os.path.join(self.tmp, "run", "step_1"))
         with open(leaf, "r+b") as fh:
             fh.truncate(4)
         self.assertEqual(mgr.all_steps(), [1])

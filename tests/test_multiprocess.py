@@ -36,6 +36,9 @@ _TELEMETRY_WORKER = os.path.join(
 _DIVERGENCE_WORKER = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "_mp_divergence_worker.py"
 )
+_CKPT_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "_mp_ckpt_worker.py"
+)
 
 
 def _free_port() -> int:
@@ -86,6 +89,17 @@ def test_multiprocess_spmd(nprocs, devices_per_proc, tmp_path):
     for i, (rc, out) in enumerate(outs):
         assert rc == 0, f"worker {i} failed (rc={rc}):\n{out[-4000:]}"
         assert f"WORKER_OK {i}" in out, f"worker {i} incomplete:\n{out[-4000:]}"
+
+
+@pytest.mark.parametrize("nprocs,devices_per_proc", [(2, 2), (4, 1)])
+def test_multiprocess_checkpoint_v2(nprocs, devices_per_proc, tmp_path):
+    """ISSUE 13: parallel per-process chunk writes commit one manifest; a
+    writer crash surfaces as an exception on EVERY rank (never a hang); a
+    non-writer chunk-write failure degrades every rank to v1 together."""
+    outs = _launch(nprocs, devices_per_proc, str(tmp_path), worker=_CKPT_WORKER)
+    for i, (rc, out) in enumerate(outs):
+        assert rc == 0, f"worker {i} failed (rc={rc}):\n{out[-4000:]}"
+        assert f"CKPT_OK {i}" in out, f"worker {i} incomplete:\n{out[-4000:]}"
 
 
 @pytest.mark.parametrize("nprocs,devices_per_proc", [(2, 2), (4, 1)])

@@ -840,25 +840,28 @@ class TestChaosCheckpoint(_ResilienceCase):
     def test_transient_write_fault_retried_roundtrip_identical(self):
         diagnostics.enable()
         x = ht.array(np.arange(20, dtype=np.float32).reshape(4, 5), split=0)
+        # ISSUE 13: the default save is the parallel chunked v2 path — its
+        # writes run under the checkpoint.chunk_write site
         resilience.arm_fault_plan(
-            [{"site": "checkpoint.write", "on_call": 1, "count": 1, "kind": "raise"}]
+            [{"site": "checkpoint.chunk_write", "on_call": 1, "count": 1,
+              "kind": "raise"}]
         )
         path = os.path.join(self.tmp, "ckpt")
         ht.save_checkpoint({"x": x}, path)  # attempt 1 injected, attempt 2 lands
         back = ht.load_checkpoint({"x": ht.zeros((4, 5), split=0)}, path)
         self.assert_array_equal(back["x"], x.numpy())
         self.assertGreaterEqual(
-            self._counters().get("resilience.retry.checkpoint.write", 0), 1
+            self._counters().get("resilience.retry.checkpoint.chunk_write", 0), 1
         )
 
     def test_torn_write_rejected_on_restore(self):
         x = ht.array(np.arange(24, dtype=np.float32), split=0)
         resilience.arm_fault_plan(
-            [{"site": "checkpoint.write", "on_call": 1, "kind": "torn-write",
-              "fraction": 0.25}]
+            [{"site": "checkpoint.chunk_write", "on_call": 1,
+              "kind": "torn-write", "fraction": 0.25}]
         )
         path = os.path.join(self.tmp, "torn")
-        ht.save_checkpoint({"x": x}, path)  # commits a silently truncated leaf
+        ht.save_checkpoint({"x": x}, path)  # commits a silently truncated chunk
         with self.assertRaises(ht.CheckpointCorrupt) as ctx:
             ht.load_checkpoint({"x": ht.zeros((24,), split=0)}, path)
         self.assertIn("torn write", str(ctx.exception))
