@@ -1,0 +1,346 @@
+"""Failover gate: a mid-load peer failure must cost ZERO untyped errors and
+ZERO dropped requests — ``admitted + shed + failed == offered`` holds exactly
+across the failure, and the pool keeps serving afterwards.
+
+The scenario (ISSUE 14, the serving leg of the supervision plane): a
+:class:`ht.serving.ModelPool` serves under open-loop load with the
+supervision plane armed (a :class:`LocalCoordinator` stands in for the
+jax.distributed KV channel, with a simulated second rank heartbeating —
+single-host and deterministic, no real process murder). Mid-run the peer
+goes silent: the REAL detection path fires — the monitor ages the stalled
+beat past ``HEAT_TPU_PEER_TIMEOUT_S``, posts the abort sentinel, and every
+in-flight request aborts typed (``PeerFailed`` at the communication
+chokepoint, typed sheds at the scheduler's pre-dispatch checkpoint). The
+driver then runs :meth:`ModelPool.on_peer_failure` — quiesce (typed sheds),
+clear the sentinel, reopen — and the remaining load must be served normally.
+
+Gates:
+
+- **accounting** — ``admitted + shed + failed == offered`` EXACTLY, where
+  ``shed`` counts typed supervision/lifecycle errors (``PeerFailed`` /
+  ``CollectiveTimeout`` / ``Shed`` / ``DeadlineExceeded`` /
+  ``RequestCancelled`` / ``DrainTimeout``) and ``failed`` counts anything
+  untyped — which must be ZERO.
+- **the failure bit** — at least one request was typed-shed by the failure
+  (the window was exercised) and the pool ledger shows exactly one
+  ``peer-failover`` entry.
+- **recovery** — requests complete successfully AFTER the failover (the pool
+  survived), and every admitted value matches the single generation (nothing
+  torn).
+- **failover latency envelope** — ``on_peer_failure``'s wall time stays
+  under the committed ``max_failover_ms`` (``serving_baseline.json``'s
+  ``_failover_gate`` section; a missing entry warns visibly, never silently
+  passes).
+
+Standalone::
+
+    python benchmarks/serving/failover_gate.py --devices 8 --smoke --check \\
+        --baseline benchmarks/serving/serving_baseline.json
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from benchmarks.serving.harness import (  # noqa: E402
+    _bootstrap, _poisson_arrivals, _sched_snapshot, _sched_pressure,
+)
+
+N = 8192
+SCALE = 2.0
+PEER_TIMEOUT_S = 0.6
+
+
+def _build_pool():
+    import numpy as np
+
+    import heat_tpu as ht
+
+    w = ht.array(np.full(N, SCALE, np.float32), split=0)
+    pool = ht.serving.ModelPool({"w": ht.zeros((N,), split=0)},
+                                name="failover-gate")
+    pool._rebind({"w": w}, "gen-A")
+    x = ht.array(np.arange(N, dtype=np.float32), np.float32, split=0)
+    base = float(np.arange(N, dtype=np.float32).sum())
+    expect = SCALE * base + SCALE * N
+
+    def request(_i: int) -> float:
+        w = pool.state["w"]
+        y = x * w
+        y = y + w
+        return float(y.sum().item())
+
+    return pool, request, expect
+
+
+def _drive(pool, request, expect, offered_rps, n_requests, concurrency, emit):
+    """Open-loop drive with a peer failure mid-run. Returns the gate record."""
+    from heat_tpu.core import profiler, resilience, supervision
+
+    arrivals = _poisson_arrivals(n_requests, offered_rps, seed=23)
+    outcomes = [None] * n_requests  # (status, value-or-error, t_done)
+    start = time.perf_counter()
+    counter = [0]
+    lock = threading.Lock()
+    failover = {}
+
+    # ---- the simulated peer: a second "rank" heartbeating on the shared
+    # local channel until the failure instant
+    co = supervision.LocalCoordinator()
+    mon = supervision.arm(co, rank=0, nprocs=2,
+                          peer_timeout_s=PEER_TIMEOUT_S, start_thread=True)
+    peer_alive = threading.Event()
+    peer_alive.set()
+
+    def peer_beats():
+        seq = 0
+        while peer_alive.is_set():
+            seq += 1
+            co.set(f"{mon.ns}/hb/1", str(seq), True)
+            time.sleep(0.1)
+
+    beater = threading.Thread(target=peer_beats, daemon=True)
+    beater.start()
+
+    def _completed() -> int:
+        return sum(1 for o in outcomes if o is not None)
+
+    def failer():
+        # anchor the failure on COMPLETIONS so both sides carry load
+        while _completed() < n_requests // 3:
+            time.sleep(0.002)
+        t0 = time.perf_counter()
+        peer_alive.clear()          # rank 1 goes silent: real detection path
+        deadline = time.monotonic() + 30.0
+        while supervision.aborted() is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        failover["detected"] = supervision.aborted() is not None
+        failover["detect_ms"] = (time.perf_counter() - t0) * 1e3
+        # let the typed-abort window actually bite some traffic
+        time.sleep(5 * PEER_TIMEOUT_S / 3)
+        t1 = time.perf_counter()
+        entry = pool.on_peer_failure(
+            resilience.PeerFailed(1, PEER_TIMEOUT_S, detected_by=0),
+            drain_timeout_s=10.0,
+        )
+        failover["t"] = time.perf_counter() - start
+        failover["wall_ms"] = (time.perf_counter() - t1) * 1e3
+        failover["entry"] = entry
+
+    def worker():
+        while True:
+            with lock:
+                i = counter[0]
+                counter[0] += 1
+            if i >= n_requests:
+                return
+            sched_t = start + arrivals[i]
+            now = time.perf_counter()
+            if now < sched_t:
+                time.sleep(sched_t - now)
+            try:
+                with profiler.request(f"failover.{i % 4}"):
+                    value = request(i)
+                outcomes[i] = ("ok", value, time.perf_counter() - start)
+            except (resilience.PeerFailed, resilience.CollectiveTimeout,
+                    resilience.CoordinationTimeout, resilience.Shed,
+                    resilience.DeadlineExceeded, resilience.RequestCancelled,
+                    resilience.DrainTimeout):
+                outcomes[i] = ("shed", None, time.perf_counter() - start)
+            except Exception as exc:  # untyped — the gate fails on any
+                outcomes[i] = ("failed", repr(exc), time.perf_counter() - start)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    fail_thread = threading.Thread(target=failer, daemon=True)
+    for t in threads:
+        t.start()
+    fail_thread.start()
+    for t in threads:
+        t.join()
+    fail_thread.join(timeout=120)
+    supervision.disarm()
+    supervision.reset_abort()
+    return _score(outcomes, failover, expect, pool, emit)
+
+
+def _score(outcomes, failover, expect, pool, emit):
+    boundary = failover.get("t")
+    sides = {"pre": {"admitted": 0, "shed": 0, "failed": 0},
+             "post": {"admitted": 0, "shed": 0, "failed": 0}}
+    bad_value = 0
+    for out in outcomes:
+        status, value, t_done = out
+        side = sides["pre" if boundary is None or t_done <= boundary else "post"]
+        if status == "ok":
+            side["admitted"] += 1
+            if abs(value - expect) >= 1e-3:
+                bad_value += 1
+        elif status == "shed":
+            side["shed"] += 1
+        else:
+            side["failed"] += 1
+            emit(json.dumps({"untyped_failure": value}))
+    offered = len(outcomes)
+    admitted = sides["pre"]["admitted"] + sides["post"]["admitted"]
+    shed = sides["pre"]["shed"] + sides["post"]["shed"]
+    failed = sides["pre"]["failed"] + sides["post"]["failed"]
+    ledger = [e for e in pool.swap_ledger() if e.get("kind") == "peer-failover"]
+    return {
+        "offered": offered,
+        "admitted": admitted,
+        "shed": shed,
+        "failed": failed,
+        "accounted": admitted + shed + failed == offered,
+        "per_side": sides,
+        "torn_values": bad_value,
+        "failure_detected": failover.get("detected", False),
+        "detect_ms": round(failover.get("detect_ms", -1.0), 3),
+        "failover_wall_ms": round(failover.get("wall_ms", -1.0), 3),
+        "failover_entry": failover.get("entry"),
+        "failover_ledger_entries": len(ledger),
+    }
+
+
+def run_failover_gate(smoke=True, requests=None, concurrency=4, emit=print):
+    import jax
+
+    from heat_tpu.core import _executor, profiler
+
+    ndev = len(jax.devices())
+    was_active = profiler.active()
+    profiler.enable()
+    try:
+        pool, request, expect = _build_pool()
+        for i in range(3):
+            request(i)  # compile paths, uncounted
+        t0 = time.perf_counter()
+        n_cap = 16
+        for i in range(n_cap):
+            request(i)
+        capacity = n_cap / (time.perf_counter() - t0)
+        offered = max(2.0, 0.6 * capacity * concurrency)
+        n_requests = requests or (96 if smoke else 400)
+        # pace the run to SPAN the failure timeline: the peer goes silent at
+        # ~1/3 completions, detection costs ~peer_timeout + a monitor tick,
+        # the abort window then bites for ~peer_timeout, and the recovery
+        # gate needs admissions AFTER the failover — arrivals must still be
+        # flowing through all of it, so cap the offered rate to stretch the
+        # run across ~10 detection budgets (a fast mesh would otherwise
+        # finish the whole workload before the monitor ever fires)
+        offered = min(offered, n_requests / (10.0 * PEER_TIMEOUT_S))
+        before = _sched_snapshot()
+        rec = _drive(pool, request, expect, offered, n_requests, concurrency,
+                     emit)
+        rec["scheduler_pressure"] = _sched_pressure(before, _sched_snapshot())
+        record = {
+            "metric": "serving_failover_gate",
+            "value": rec["failover_wall_ms"],
+            "unit": "ms",
+            "devices": ndev,
+            "concurrency": concurrency,
+            "offered_rps": round(offered, 2),
+            **rec,
+        }
+        emit(json.dumps(record))
+        return record
+    finally:
+        if not was_active:
+            profiler.disable()
+        _executor._get_scheduler().reopen()
+
+
+def evaluate(rec, envelope, emit=print) -> bool:
+    """Gate one failover record. Returns ``failed``. Pure record math, so
+    tests can drive it with canned scores."""
+    failed = False
+
+    def err(msg):
+        nonlocal failed
+        failed = True
+        emit(json.dumps({"error": msg}))
+
+    if not rec["accounted"]:
+        err(
+            f"request accounting broken across the peer failure: admitted "
+            f"{rec['admitted']} + shed {rec['shed']} + failed {rec['failed']} "
+            f"!= offered {rec['offered']}"
+        )
+    if rec["failed"]:
+        err(f"{rec['failed']} request(s) died with an UNTYPED error across "
+            "the peer failure — dropped work")
+    if rec["torn_values"]:
+        err(f"{rec['torn_values']} admitted request(s) returned a value not "
+            "matching the generation")
+    if not rec["failure_detected"]:
+        err("the heartbeat monitor never detected the silent peer")
+    if rec["shed"] <= 0:
+        err("no request was typed-shed — the failure window was not "
+            "exercised")
+    if rec["per_side"]["post"]["admitted"] <= 0:
+        err("no request succeeded AFTER the failover — the pool did not "
+            "survive the peer failure")
+    if rec["failover_ledger_entries"] != 1:
+        err(f"pool ledger holds {rec['failover_ledger_entries']} "
+            "peer-failover entries, expected exactly 1")
+    if envelope is None:
+        emit(json.dumps({
+            "warning": f"_failover_gate has no envelope for {rec['devices']} "
+            "devices; failover latency not gated"
+        }))
+        return failed
+    max_ms = envelope.get("max_failover_ms")
+    if max_ms is not None and (
+        rec["failover_wall_ms"] < 0 or rec["failover_wall_ms"] > max_ms
+    ):
+        err(f"failover wall time {rec['failover_wall_ms']} ms above the "
+            f"envelope {max_ms} ms")
+    return failed
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--requests", type=int, default=None)
+    parser.add_argument("--concurrency", type=int, default=4)
+    parser.add_argument("--check", action="store_true")
+    parser.add_argument("--baseline",
+                        help="serving_baseline.json (reads its _failover_gate "
+                        "section for this device count)")
+    args = parser.parse_args(argv)
+    _bootstrap(args.devices)
+
+    def envelope_for():
+        if not args.baseline:
+            return None
+        with open(args.baseline) as f:
+            base = json.load(f)
+        import jax
+
+        section = base.get("_failover_gate", {}).get("envelopes", {})
+        return section.get(str(len(jax.devices())))
+
+    rec = run_failover_gate(smoke=args.smoke, requests=args.requests,
+                            concurrency=args.concurrency)
+    failed = evaluate(rec, envelope_for())
+    if failed and args.check:
+        # one retry, like the overload/swap gates: a shared CI box can hiccup
+        # a single open-loop run; only failing BOTH fresh runs is red
+        print(json.dumps({"info": "failover gate failed once; retrying to "
+                          "rule out a single-run outlier"}))
+        rec = run_failover_gate(smoke=args.smoke, requests=args.requests,
+                                concurrency=args.concurrency)
+        failed = evaluate(rec, envelope_for())
+    if args.check and failed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

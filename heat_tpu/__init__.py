@@ -27,6 +27,7 @@ from .core import __version__
 from .core import diagnostics
 from .core import profiler
 from .core import resilience
+from .core import supervision
 from . import telemetry
 from . import core
 from . import fft

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 from . import (
+    rules_coord,
     rules_donation,
     rules_fallbacks,
     rules_imports,
@@ -143,6 +144,17 @@ RULES = {
         "to ht.diagnostics (the per-collective telemetry contract) and "
         "ht.resilience/_guarded. Call the MeshCommunication method instead."
     ),
+    "coord-unbounded-wait": (
+        "A raw jax.distributed coordination wait (blocking_key_value_get / "
+        "wait_at_barrier) outside the supervision wrapper, or one without a "
+        "bounded timeout inside it: an unbounded coordination block is "
+        "exactly the hang the supervision plane (ISSUE 14) eliminates. "
+        "Route the wait through supervision.kv_wait/kv_barrier — bounded by "
+        "HEAT_TPU_COORD_TIMEOUT_MS, sentinel-abortable mid-wait, and typed "
+        "(resilience.CoordinationTimeout names the key and the ranks that "
+        "never arrived; a detected peer death raises PeerFailed instead of "
+        "waiting out the budget)."
+    ),
     "pragma-no-reason": (
         "Every suppression pragma must carry `-- reason`: suppressions "
         "without recorded justification are how grandfathered bugs hide."
@@ -172,6 +184,7 @@ RULE_RUNNERS = [
     rules_donation.run,
     rules_spmd.run,
     rules_layout.run,
+    rules_coord.run,
 ]
 
 

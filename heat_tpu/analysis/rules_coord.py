@@ -1,0 +1,82 @@
+"""Coordination-channel discipline: ``coord-unbounded-wait``.
+
+The ``jax.distributed`` coordination channel (the KV store + barriers) is the
+framework's only cross-process transport that works on every backend, and a
+raw wait on it is exactly the unbounded, un-abortable block the supervision
+plane (ISSUE 14) exists to eliminate: before it, two hardcoded timeouts
+(``communication._HANDSHAKE_TIMEOUT_MS``, ``checkpoint._COORD_TIMEOUT_MS``)
+were the ONLY guards, and their expiry surfaced as an opaque backend error.
+Every coordination wait must now route through the supervision-aware
+wrappers — ``supervision.kv_wait`` / ``supervision.kv_barrier`` — which chunk
+the block so the abort sentinel is polled mid-wait, bound it by the unified
+``HEAT_TPU_COORD_TIMEOUT_MS`` budget, and raise typed
+``resilience.CoordinationTimeout`` / ``PeerFailed`` instead.
+
+Statically:
+
+- any call to a raw waiting primitive (``blocking_key_value_get``,
+  ``blocking_key_value_get_bytes``, ``wait_at_barrier``) OUTSIDE
+  ``heat_tpu.core.supervision`` is a finding — call the wrapper;
+- inside ``supervision`` itself, the raw call must pass an explicit bounded
+  timeout argument (the wrapper's chunked-wait contract) — a call without
+  one is a finding too.
+
+The committed baseline stays empty: there are no grandfathered raw waits.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .engine import Finding, Universe
+
+#: the raw waiting primitives of the coordination client
+RAW_WAITS = {
+    "blocking_key_value_get",
+    "blocking_key_value_get_bytes",
+    "wait_at_barrier",
+}
+
+#: the one module allowed to touch them (the supervision-aware wrapper)
+WRAPPER_MODULE = "heat_tpu.core.supervision"
+
+
+def run(uni: Universe) -> List[Finding]:
+    out: List[Finding] = []
+    for name in sorted(uni.modules):
+        mod = uni.modules[name]
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute) or func.attr not in RAW_WAITS:
+                continue
+            if name != WRAPPER_MODULE:
+                out.append(mod.finding(
+                    "coord-unbounded-wait", node,
+                    f"raw coordination wait {func.attr!r} outside the "
+                    "supervision wrapper: route it through "
+                    "supervision.kv_wait/kv_barrier so the block is bounded "
+                    "(HEAT_TPU_COORD_TIMEOUT_MS), sentinel-abortable, and "
+                    "typed (resilience.CoordinationTimeout/PeerFailed)",
+                ))
+                continue
+            # inside the wrapper: the raw call must carry a bounded timeout
+            has_timeout = len(node.args) >= 2 or any(
+                kw.arg in ("timeout_in_ms", "timeout_ms") for kw in node.keywords
+            )
+            bounded = has_timeout and not any(
+                isinstance(a, ast.Constant) and a.value is None
+                for a in list(node.args[1:2]) + [
+                    kw.value for kw in node.keywords
+                    if kw.arg in ("timeout_in_ms", "timeout_ms")
+                ]
+            )
+            if not bounded:
+                out.append(mod.finding(
+                    "coord-unbounded-wait", node,
+                    f"{func.attr!r} inside the supervision wrapper must pass "
+                    "an explicit bounded timeout (the chunked-wait contract)",
+                ))
+    return out

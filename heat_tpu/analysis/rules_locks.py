@@ -112,9 +112,24 @@ LOCK_POLICY: Dict[str, ModulePolicy] = {
     "heat_tpu.core.resilience": ModulePolicy(
         locks={"_lock": {
             "_site_policies", "_breakers", "_plan", "_site_calls", "_fired",
-            "_armed", "_active",
+            "_armed", "_active", "_fault_rank",
         }},
-        relaxed={"_tmp_seq", "_jitter_rng"},
+        relaxed={"_tmp_seq", "_jitter_rng", "_peer_dead_hook",
+                 "_peer_dead_exit"},
+    ),
+    # supervision.py "Thread-safety" section: the watchdog window table, the
+    # abort payload, monitor/thread handles, identity, graveyard and restart
+    # count all under the (leaf) module _lock; _armed/_aborted are the
+    # relaxed hot-path switches (the payload they point at is installed
+    # before the flag flips and never mutated after); _knobs is the memoised
+    # env-knob cell like the executor's; _watch_seq an atomic counter.
+    "heat_tpu.core.supervision": ModulePolicy(
+        locks={"_lock": {
+            "_abort", "_monitor", "_thread", "_thread_stop", "_generation",
+            "_watch_windows", "_watch_fired", "_graveyard", "_rank",
+            "_nprocs", "_restarts", "_owns_client", "_atexit_registered",
+        }},
+        relaxed={"_armed", "_aborted", "_knobs", "_watch_seq"},
     ),
     # _executor.py: the signature table and its satellites under _lock
     # (_tlock wraps it, _lock_acquire is the timed acquire); the donation

@@ -133,7 +133,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import _scheduler, diagnostics, profiler, resilience
+from . import _scheduler, diagnostics, profiler, resilience, supervision
 from ._scheduler import PendingValue
 
 __all__ = [
@@ -345,8 +345,12 @@ def reload_env_knobs() -> None:
     ``QUARANTINE_AFTER`` / ``SHED``) are parsed once at import and memoised off the hot
     dispatch path; in-process environment mutations take effect at the next
     call to this function (or to :func:`clear_executor_cache`, which re-reads
-    as part of dropping the program table)."""
+    as part of dropping the program table). The supervision plane's memoised
+    knobs (``HEAT_TPU_SUPERVISION`` / ``PEER_TIMEOUT_S`` /
+    ``COLLECTIVE_TIMEOUT_S`` / ``COORD_TIMEOUT_MS``) re-read here too, so one
+    call covers the whole framework."""
     _knobs.reload()
+    supervision.reload_env_knobs()
 
 
 def jit_threshold() -> int:
@@ -1586,6 +1590,16 @@ def _tenant_or_none() -> Optional[str]:
 def _force_graph_inner(roots: Tuple[Deferred, ...]) -> bool:
     """Returns True when this call planned work (executed, or submitted a
     dispatch); False when every root was already forced/in flight."""
+    if supervision._aborted:
+        # the executor's supervision checkpoint (the inline-dispatch
+        # counterpart of the scheduler loop's): once the abort sentinel is
+        # up, a force is refused TYPED at admission — nothing planned yet,
+        # so the nodes stay unforced and a post-recovery force computes
+        # them normally. Idle cost: one module-attribute read.
+        abort = supervision.abort_error("executor.force")
+        if abort is not None:
+            _get_scheduler().note_lifecycle("shed", _tenant_or_none())
+            raise abort
     deadline = _roots_deadline(roots)
     if deadline is not None and time.monotonic() >= deadline:
         # admission checkpoint: the deadline has already passed, so planning,

@@ -80,8 +80,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 try:  # standalone file-path load (driver entry points): no parent package —
     from . import resilience  # the lifecycle verbs are never used in that mode
+    from . import supervision  # sentinel checkpoint; stdlib-only like us
 except ImportError:  # pragma: no cover - exercised via tests/test_analysis.py
-    resilience = None
+    resilience = supervision = None
 
 __all__ = ["PendingValue", "WorkItem", "DispatchScheduler"]
 
@@ -461,6 +462,22 @@ class DispatchScheduler:
                 )
             if not group:
                 continue
+            if supervision is not None and supervision._armed:
+                # the scheduler's supervision checkpoint: once the abort
+                # sentinel is up, queued work is SHED typed (PeerFailed /
+                # CollectiveTimeout) pre-dispatch instead of walking into a
+                # collective whose peer is gone — counted in the lifecycle
+                # ledger like every other rejection, never silently dropped
+                abort = supervision.abort_error("scheduler.dispatch")
+                if abort is not None:
+                    with self._cv:
+                        for w in group:
+                            self._count_lifecycle_locked("shed", w.tenant)
+                        self._active -= 1
+                        self._cv.notify_all()
+                    for w in group:
+                        self._deliver_lifecycle(w, "shed", abort)
+                    continue
             try:
                 if len(group) == 1:
                     group[0].execute()
@@ -574,7 +591,7 @@ class DispatchScheduler:
             self._cv.notify_all()
 
     @contextlib.contextmanager
-    def quiesce(self, timeout: float = 30.0):
+    def quiesce(self, timeout: float = 30.0, *, tolerate_shed: bool = False):
         """Drain, yield a quiesced scheduler for the caller's critical section
         (model hot-swap rebinds serving state here), and reopen — on a
         clean flush, on a :class:`~.resilience.DrainTimeout` (whose queued
@@ -582,6 +599,17 @@ class DispatchScheduler:
         alike, so a failed swap can never leave admission closed forever.
         While quiesced, refused submits execute inline on their caller's
         thread (``submit`` contract): requests slow down, none are dropped.
+
+        By default a timed-out drain skips the critical section (a hot-swap
+        must not rebind over a window it could not flush cleanly).
+        ``tolerate_shed`` runs the body anyway: a timed-out drain has
+        already delivered or shed every queued item typed, so the scheduler
+        is exactly as quiesced as after a clean flush — callers whose
+        critical section must execute while admission is STILL CLOSED even
+        on a shed window (the peer-failover sentinel clear: clearing it
+        after reopen would shed freshly admitted requests on a stale abort)
+        opt in, and the ``DrainTimeout`` is re-raised on exit so the shed
+        work is still accounted.
 
         The reopen yields to a DELIBERATE closure: if admission was already
         closed when quiesce began, or another drain ran during the window
@@ -591,14 +619,23 @@ class DispatchScheduler:
         with self._cv:
             was_draining = self._draining
             epoch = self._drains
+        shed: Optional[BaseException] = None
         try:
-            self.drain(timeout)  # epoch + 1 (increments before it can raise)
+            try:
+                self.drain(timeout)  # epoch + 1 (increments before it can raise)
+            except Exception as exc:
+                if not (tolerate_shed and resilience is not None
+                        and isinstance(exc, resilience.DrainTimeout)):
+                    raise
+                shed = exc
             yield self
         finally:
             with self._cv:
                 if not was_draining and self._drains == epoch + 1:
                     self._draining = False
                     self._cv.notify_all()
+        if shed is not None:
+            raise shed
 
     def draining(self) -> bool:
         with self._cv:

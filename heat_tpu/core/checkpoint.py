@@ -95,7 +95,7 @@ import numpy as np
 
 import jax
 
-from . import diagnostics, io, resilience
+from . import diagnostics, io, resilience, supervision
 from . import types as _types
 from .communication import sanitize_comm
 from .devices import sanitize_device
@@ -225,14 +225,20 @@ def _is_writer() -> bool:
 
 
 #: Cross-process agreement rides the ``jax.distributed`` coordination service
-#: (barriers + the KV store) — the same no-XLA channel as
+#: (the KV store) — the same no-XLA channel as
 #: ``communication._telemetry_bootstrap`` — so the crash contract holds on
 #: every backend, CPU meshes included (multiprocess XLA collectives are
-#: accelerator-only). Barrier ids and KV keys are single-use: the sequence
+#: accelerator-only). KV keys are namespace-scoped per use: the sequence
 #: counter below hands every rank the same fresh namespace per operation,
 #: which stays aligned because every save's collective sequence is
-#: rank-symmetric by construction (the module's core invariant).
-_COORD_TIMEOUT_MS = 600_000
+#: rank-symmetric by construction (the module's core invariant). Every wait
+#: goes through the supervised wrappers (``supervision.kv_wait`` /
+#: ``kv_barrier``): bounded by the unified ``HEAT_TPU_COORD_TIMEOUT_MS``
+#: budget (replacing the 600 s hardcoded here pre-supervision),
+#: sentinel-abortable mid-wait (a dead peer raises typed
+#: ``resilience.PeerFailed`` instead of stalling the save), and typed
+#: ``resilience.CoordinationTimeout`` on exhaustion instead of an opaque
+#: backend error.
 _coord_seq = 0
 _coord_my_keys: List[Tuple[int, str]] = []
 
@@ -310,9 +316,11 @@ def _coord_gather(value) -> np.ndarray:
         })
     _coord_publish(client, seq, f"{ns}/{jax.process_index()}", json.dumps(mine))
     out = np.zeros(value.shape, dtype)
+    co = supervision.ClientCoordinator(client)
     for r in range(jax.process_count()):
         items = json.loads(
-            client.blocking_key_value_get(f"{ns}/{r}", _COORD_TIMEOUT_MS)
+            supervision.kv_wait(f"{ns}/{r}", site="checkpoint.coord",
+                                coordinator=co)
         )
         for item in items:
             region = tuple(slice(b, e) for b, e in item["index"])
@@ -328,7 +336,16 @@ def _barrier(tag: str) -> None:
     if jax.process_count() > 1:
         client = _coord_client()
         seq, ns = _coord_ns(f"barrier/{tag}")
-        client.wait_at_barrier(ns, _COORD_TIMEOUT_MS)
+        # the supervised KV barrier (not the native wait_at_barrier): it is
+        # sentinel-abortable MID-WAIT and its timeout names the ranks that
+        # never arrived; _coord_publish registers this rank's key for the
+        # sweep (kv_barrier's own re-set of it is an idempotent overwrite)
+        _coord_publish(client, seq, f"{ns}/{jax.process_index()}", "1")
+        supervision.kv_barrier(
+            ns, nprocs=jax.process_count(), rank=jax.process_index(),
+            site="checkpoint.barrier",
+            coordinator=supervision.ClientCoordinator(client),
+        )
         _coord_sweep(client, seq)
 
 
@@ -342,8 +359,10 @@ def _agree_min(flag: int) -> int:
     client = _coord_client()
     seq, ns = _coord_ns("agree")
     _coord_publish(client, seq, f"{ns}/{jax.process_index()}", str(int(flag)))
+    co = supervision.ClientCoordinator(client)
     agreed = min(
-        int(client.blocking_key_value_get(f"{ns}/{i}", _COORD_TIMEOUT_MS))
+        int(supervision.kv_wait(f"{ns}/{i}", site="checkpoint.agree",
+                                coordinator=co))
         for i in range(jax.process_count())
     )
     _coord_sweep(client, seq)

@@ -36,14 +36,20 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from . import diagnostics, profiler, resilience, telemetry
+from . import diagnostics, profiler, resilience, supervision, telemetry
 
 
 def _guarded(site, fn, *args, **kwargs):
-    """Run one collective (or layout) invocation under ht.resilience,
-    ht.profiler, and ht.telemetry.
+    """Run one collective (or layout) invocation under ht.supervision,
+    ht.resilience, ht.profiler, and ht.telemetry.
 
-    Idle fast path: one module-attribute read per subsystem. When a fault plan
+    Idle fast path: one module-attribute read per subsystem. When the
+    supervision plane is armed (multi-process jobs by default), the abort
+    sentinel is polled before AND after the invocation — a peer failure
+    raises typed ``PeerFailed`` on this rank instead of entering a collective
+    its dead peer will never join — and, with
+    ``HEAT_TPU_COLLECTIVE_TIMEOUT_S`` set, the invocation window is armed on
+    the collective watchdog (``supervision.watch``). When a fault plan
     is armed or a site policy is registered, the call goes through
     ``resilience.guard`` — injected faults fire per attempt and the site
     policy retries. When the profiler is active the invocation is additionally
@@ -54,8 +60,15 @@ def _guarded(site, fn, *args, **kwargs):
     the per-(site, seq) enter/exit record the cross-process merge turns into
     skew histograms and straggler attribution. All of it is host-side timing
     only; nothing enters the traced body, so the compiled HLO never changes
-    (the byte-parity contracts in ``tests/test_resilience.py`` and
-    ``tests/test_profiler.py``)."""
+    (the byte-parity contracts in ``tests/test_resilience.py``,
+    ``tests/test_profiler.py`` and ``tests/test_supervision.py``)."""
+    if supervision._armed:
+        with supervision.watch(site):
+            return _guarded_telemetry(site, fn, *args, **kwargs)
+    return _guarded_telemetry(site, fn, *args, **kwargs)
+
+
+def _guarded_telemetry(site, fn, *args, **kwargs):
     if telemetry._collecting:
         with telemetry.collective_window(site):
             return _guarded_run(site, fn, *args, **kwargs)
@@ -97,11 +110,22 @@ if os.environ.get("HEAT_TPU_COORDINATOR_ADDRESS"):
             "HEAT_TPU_PROCESS_ID"
         )
     if jax._src.distributed.global_state.client is None:  # not already initialized
-        jax.distributed.initialize(
-            coordinator_address=os.environ["HEAT_TPU_COORDINATOR_ADDRESS"],
-            num_processes=int(os.environ["HEAT_TPU_NUM_PROCESSES"]),
-            process_id=int(os.environ["HEAT_TPU_PROCESS_ID"]),
-        )
+        if supervision.enabled():
+            # the supervised runtime: identical observable bootstrap, but
+            # XLA's fail-stop error propagation is disabled — peer-failure
+            # detection, typed delivery, and elastic restart belong to
+            # ht.supervision (see its module header)
+            supervision.bootstrap_distributed(
+                os.environ["HEAT_TPU_COORDINATOR_ADDRESS"],
+                int(os.environ["HEAT_TPU_NUM_PROCESSES"]),
+                int(os.environ["HEAT_TPU_PROCESS_ID"]),
+            )
+        else:
+            jax.distributed.initialize(
+                coordinator_address=os.environ["HEAT_TPU_COORDINATOR_ADDRESS"],
+                num_processes=int(os.environ["HEAT_TPU_NUM_PROCESSES"]),
+                process_id=int(os.environ["HEAT_TPU_PROCESS_ID"]),
+            )
 
 __all__ = [
     "Communication",
@@ -682,29 +706,36 @@ def _pad_reshard(
     return _guarded("comm.reshard", fn, array)
 
 
-_HANDSHAKE_TIMEOUT_MS = 60_000
-
-# Every bootstrap (import, then each explicit initialize()) gets its own
-# barrier id + KV namespace: coordination barriers cannot be re-waited and
-# KV keys cannot be re-set, and SPMD symmetry keeps the counter in step on
+# Every bootstrap (import, then each explicit initialize() — and each elastic
+# restart) gets its own barrier id + KV namespace: coordination KV keys are
+# namespace-scoped per use, and SPMD symmetry keeps the counter in step on
 # every process, so a re-init re-anchors instead of failing the handshake.
+# The wait budget is the unified HEAT_TPU_COORD_TIMEOUT_MS knob
+# (supervision.coord_timeout_ms — replacing the old hardcoded 60 s here and
+# 600 s in checkpoint), and every wait goes through the supervised wrappers:
+# bounded, sentinel-abortable, and typed (resilience.CoordinationTimeout /
+# PeerFailed) instead of an opaque backend error.
 _handshake_generation = 0
 
 
 def _telemetry_bootstrap() -> None:
     """Stamp this process's rank into ht.telemetry and, on multi-process jobs,
-    run the boot-time clock-offset handshake: a coordination-service barrier,
-    then every process samples ``time.monotonic_ns()`` and publishes it
-    through the distributed KV store (one logical allgather of the anchors) —
-    the zero point that lets ``telemetry.merge`` align trace timestamps
-    across ranks. The handshake rides the ``jax.distributed`` coordination
-    channel, never an XLA computation, so it works on every backend (CPU
-    meshes included) and cannot touch any compiled program — HLO-untouched by
-    construction. Accuracy is the barrier's exit skew (sub-millisecond on one
-    host, network-RTT across hosts; the docs state the caveat)."""
+    run the boot-time clock-offset handshake: a coordination-service barrier
+    (the supervised KV form), then every process samples
+    ``time.monotonic_ns()`` and publishes it through the distributed KV store
+    (one logical allgather of the anchors) — the zero point that lets
+    ``telemetry.merge`` align trace timestamps across ranks. The handshake
+    rides the ``jax.distributed`` coordination channel, never an XLA
+    computation, so it works on every backend (CPU meshes included) and
+    cannot touch any compiled program — HLO-untouched by construction.
+    Accuracy is the barrier's exit skew (sub-millisecond on one host,
+    network-RTT across hosts; the docs state the caveat). Afterwards the
+    supervision plane is armed for the job (heartbeats + sentinel polling)
+    and this process's rank is stamped for ``rank``-targeted fault plans."""
     global _handshake_generation
     try:
         telemetry.set_process_info(jax.process_index(), jax.process_count())
+        resilience.set_fault_rank(jax.process_index())
         if (
             jax.process_count() > 1
             and os.environ.get("HEAT_TPU_TELEMETRY_HANDSHAKE") != "0"
@@ -712,19 +743,30 @@ def _telemetry_bootstrap() -> None:
             client = jax._src.distributed.global_state.client
             if client is None:
                 raise RuntimeError("jax.distributed client not initialized")
+            co = supervision.ClientCoordinator(client)
             gen = _handshake_generation
             _handshake_generation += 1  # ht: ignore[lock-racing-increment] -- bootstrap-only: runs at module import and inside initialize(), both single-threaded launch paths; SPMD symmetry (not thread-safety) is what keeps the counter aligned
-            client.wait_at_barrier(
-                f"heat_tpu_telemetry_clock/{gen}", _HANDSHAKE_TIMEOUT_MS
+            index = jax.process_index()
+            # boot-time liveness wait, capped at the old 60 s handshake
+            # budget: the supervision plane is not armed yet (auto_arm runs
+            # after the handshake), so a peer that died pre-handshake cannot
+            # be sentinel-aborted mid-wait — letting this wait default to
+            # the full 600 s coordination budget would stall every
+            # survivor's boot 10x longer than pre-supervision. The unified
+            # knob still bounds it downward (HEAT_TPU_COORD_TIMEOUT_MS
+            # below 60 s shortens the handshake too).
+            boot_ms = min(supervision.coord_timeout_ms(), 60_000)
+            supervision.kv_barrier(
+                f"heat_tpu/telemetry/clock/{gen}",
+                nprocs=jax.process_count(), rank=index, timeout_ms=boot_ms,
+                site="telemetry.handshake", coordinator=co,
             )
             anchor = time.monotonic_ns()
-            index = jax.process_index()
-            client.key_value_set(
-                f"heat_tpu/telemetry/anchor/{gen}/{index}", str(anchor)
-            )
+            co.set(f"heat_tpu/telemetry/anchor/{gen}/{index}", str(anchor))
             anchors = [
-                int(client.blocking_key_value_get(
-                    f"heat_tpu/telemetry/anchor/{gen}/{i}", _HANDSHAKE_TIMEOUT_MS
+                int(supervision.kv_wait(
+                    f"heat_tpu/telemetry/anchor/{gen}/{i}", boot_ms,
+                    site="telemetry.handshake", coordinator=co,
                 ))
                 for i in range(jax.process_count())
             ]
@@ -736,6 +778,7 @@ def _telemetry_bootstrap() -> None:
         diagnostics.record_resilience_event(
             "telemetry.handshake", "degraded", f"{type(exc).__name__}: {exc}"
         )
+    supervision.auto_arm()
 
 
 # --------------------------------------------------------------------------- singletons
@@ -798,9 +841,25 @@ def initialize(**kwargs) -> None:
       ``ht.load*`` reads the file on every process (shared filesystem assumed, like
       the reference's MPI-IO setups) and populates only addressable shards;
     - per-process ingest of pre-distributed data uses ``ht.array(..., is_split=k)``.
+
+    With the supervision plane enabled (the default) and the full explicit
+    coordination triple given, the runtime is built in SUPERVISED mode
+    (``supervision.bootstrap_distributed``): observably identical, but peer
+    failures deliver typed errors instead of XLA's process-terminating
+    fail-stop, and elastic restart (``ht.resilience.run_supervised``) becomes
+    possible. Auto-detected launches (TPU/Slurm args omitted) keep the stock
+    ``jax.distributed.initialize`` path.
     """
-    jax.distributed.initialize(**kwargs)
-    global COMM_WORLD, __default_comm
+    explicit = {"coordinator_address", "num_processes", "process_id"}
+    if supervision.enabled() and explicit.issubset(kwargs):
+        supervision.bootstrap_distributed(
+            kwargs["coordinator_address"], int(kwargs["num_processes"]),
+            int(kwargs["process_id"]),
+        )
+    else:
+        jax.distributed.initialize(**kwargs)
+    global COMM_WORLD, COMM_SELF, __default_comm
     COMM_WORLD = MeshCommunication()
+    COMM_SELF = MeshCommunication(jax.devices()[:1])
     __default_comm = COMM_WORLD
     _telemetry_bootstrap()

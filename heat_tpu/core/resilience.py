@@ -115,6 +115,11 @@ __all__ = [
     "RequestCancelled",
     "DrainTimeout",
     "SwapFailed",
+    "PeerFailed",
+    "CollectiveTimeout",
+    "CoordinationTimeout",
+    "set_fault_rank",
+    "run_supervised",
 ]
 
 # Hot-path gates, read as ``resilience._armed`` / ``resilience._active`` by the
@@ -126,7 +131,10 @@ _active: bool = False
 
 _lock = threading.RLock()
 
-FAULT_KINDS = ("raise", "timeout", "backend-down", "torn-write", "deadline-exceeded")
+FAULT_KINDS = (
+    "raise", "timeout", "backend-down", "torn-write", "deadline-exceeded",
+    "peer-dead",
+)
 
 
 class FaultInjected(RuntimeError):
@@ -214,6 +222,73 @@ class InjectedDeadlineExceeded(FaultInjected, DeadlineExceeded):
     """Injected ``deadline-exceeded`` fault — also a :class:`DeadlineExceeded`
     so the executor's lifecycle paths (typed delivery, no eager replay of
     over-deadline work, no quarantine) treat it exactly like a real expiry."""
+
+
+# ------------------------------------------------------------- supervision
+class PeerFailed(RuntimeError):
+    """A peer process stopped heartbeating past ``HEAT_TPU_PEER_TIMEOUT_S``
+    (or the coordination runtime reported it dead): the supervision plane
+    (``ht.supervision``) posts a cluster-wide abort sentinel and EVERY
+    survivor raises this at its next chokepoint — collective invocation,
+    scheduler dispatch, or supervised coordination wait — instead of hanging.
+    ``rank`` is the failed peer (-1 when unknown), ``last_seen_s`` how long
+    it had been silent when declared dead, ``detected_by`` the rank whose
+    monitor posted the sentinel. Never retried by a :class:`Policy` — the
+    recovery path is ``run_supervised``'s elastic restart."""
+
+    def __init__(self, rank: int, last_seen_s: float, *, detected_by: int = -1):
+        self.rank = int(rank)
+        self.last_seen_s = float(last_seen_s)
+        self.detected_by = int(detected_by)
+        super().__init__(
+            f"peer rank {self.rank} failed (no heartbeat for "
+            f"{self.last_seen_s:.3f}s; detected by rank {self.detected_by}); "
+            "all survivors abort typed at their next supervision chokepoint"
+        )
+
+
+class CollectiveTimeout(RuntimeError):
+    """The collective watchdog (``HEAT_TPU_COLLECTIVE_TIMEOUT_S``) found a
+    ``MeshCommunication._guarded`` invocation window stuck past its deadline:
+    a flight-recorder post-mortem was dumped (trigger kind
+    ``supervision.watchdog``), the abort sentinel posted, and this raised on
+    every survivor — the stuck rank itself raises the moment its call
+    unblocks. ``site`` is the guarded call site, ``elapsed_s`` how long the
+    window had been open when flagged."""
+
+    def __init__(self, site: str, elapsed_s: float, *, detected_by: int = -1):
+        self.site = str(site)
+        self.elapsed_s = float(elapsed_s)
+        self.detected_by = int(detected_by)
+        super().__init__(
+            f"collective at {self.site!r} exceeded its watchdog deadline "
+            f"({self.elapsed_s:.3f}s elapsed; detected by rank "
+            f"{self.detected_by})"
+        )
+
+
+class CoordinationTimeout(RuntimeError):
+    """A supervised coordination-channel wait (``supervision.kv_wait`` /
+    ``kv_barrier``) exhausted its ``HEAT_TPU_COORD_TIMEOUT_MS`` budget: the
+    typed replacement for the raw KV/barrier backend errors the old
+    hardcoded handshake/checkpoint timeouts surfaced. ``key`` names the
+    coordination key waited on; ``waiting_on`` lists the ranks that never
+    arrived (barriers); ``detail`` carries the last backend error text."""
+
+    def __init__(self, site: str, *, key: str = "", timeout_ms: int = 0,
+                 waiting_on=(), detail: str = ""):
+        self.site = str(site)
+        self.key = str(key)
+        self.timeout_ms = int(timeout_ms)
+        self.waiting_on = [int(r) for r in waiting_on]
+        self.detail = str(detail)
+        ranks = (f"; ranks not arrived: {self.waiting_on}"
+                 if self.waiting_on else "")
+        extra = f"; last error: {self.detail}" if self.detail else ""
+        super().__init__(
+            f"coordination wait at {self.site!r} for key {self.key!r} "
+            f"exceeded {self.timeout_ms}ms{ranks}{extra}"
+        )
 
 
 def _record_event(site: str, kind: str, detail: str = "") -> None:
@@ -609,21 +684,25 @@ def relay_breaker() -> CircuitBreaker:
 
 # ------------------------------------------------------------------ fault injection
 class _FaultEntry:
-    __slots__ = ("site", "kind", "on_call", "count", "fraction", "message")
+    __slots__ = ("site", "kind", "on_call", "count", "fraction", "message",
+                 "rank")
 
-    def __init__(self, site, kind, on_call, count, fraction, message):
+    def __init__(self, site, kind, on_call, count, fraction, message,
+                 rank=None):
         self.site = site
         self.kind = kind
         self.on_call = on_call
         self.count = count
         self.fraction = fraction
         self.message = message
+        self.rank = rank
 
     def as_dict(self) -> dict:
         return {
             "site": self.site, "kind": self.kind, "on_call": self.on_call,
             "count": self.count, "fraction": self.fraction,
             **({"message": self.message} if self.message else {}),
+            **({"rank": self.rank} if self.rank is not None else {}),
         }
 
 
@@ -644,7 +723,9 @@ def _parse_plan(spec: Union[str, Sequence[dict]]) -> Dict[str, List[_FaultEntry]
     for i, raw in enumerate(spec):
         if not isinstance(raw, dict):
             raise ValueError(f"fault-plan entry {i} must be an object, got {type(raw)}")
-        unknown = set(raw) - {"site", "kind", "on_call", "count", "fraction", "message"}
+        unknown = set(raw) - {
+            "site", "kind", "on_call", "count", "fraction", "message", "rank",
+        }
         if unknown:
             raise ValueError(f"fault-plan entry {i} has unknown keys {sorted(unknown)}")
         site = raw.get("site")
@@ -662,8 +743,14 @@ def _parse_plan(spec: Union[str, Sequence[dict]]) -> Dict[str, List[_FaultEntry]
         fraction = float(raw.get("fraction", 0.5))
         if not 0.0 <= fraction < 1.0:
             raise ValueError(f"fault-plan entry {i}: fraction must be in [0, 1)")
+        rank = raw.get("rank")
+        if rank is not None and (not isinstance(rank, int) or rank < 0):
+            raise ValueError(
+                f"fault-plan entry {i}: rank must be a process index >= 0"
+            )
         plan.setdefault(site, []).append(
-            _FaultEntry(site, kind, on_call, count, fraction, raw.get("message", ""))
+            _FaultEntry(site, kind, on_call, count, fraction,
+                        raw.get("message", ""), rank)
         )
     return plan
 
@@ -711,7 +798,9 @@ def fault_signal(site: str) -> Optional[_FaultEntry]:
     any. The non-raising form for sites that handle kinds specially (probe
     sites map ``backend-down`` to a recorded DOWN result; :func:`atomic_write`
     maps ``torn-write`` to a truncated payload). Most sites use
-    :func:`maybe_fault` instead."""
+    :func:`maybe_fault` instead. Entries carrying a ``rank`` fire only on the
+    process whose :func:`set_fault_rank` identity matches — one env-armed
+    plan can target one rank of a multi-process chaos job."""
     if not _armed:
         return None
     global _fired
@@ -719,6 +808,8 @@ def fault_signal(site: str) -> Optional[_FaultEntry]:
         n = _site_calls.get(site, 0) + 1
         _site_calls[site] = n
         for entry in _plan.get(site, ()):
+            if entry.rank is not None and entry.rank != _fault_rank:
+                continue
             if entry.on_call <= n < entry.on_call + entry.count:
                 _fired += 1
                 _record_event(site, "fault", f"{entry.kind} fired on call {n}")
@@ -735,7 +826,8 @@ def maybe_fault(site: str) -> None:
 
 
 def raise_entry(entry: _FaultEntry, site: str) -> None:
-    """Raise the exception form of a fired plan entry."""
+    """Raise the exception form of a fired plan entry (``peer-dead`` does not
+    return at all: the process exits)."""
     msg = entry.message or f"injected {entry.kind} at {site!r}"
     if entry.kind == "timeout":
         raise InjectedTimeout(msg)
@@ -743,6 +835,49 @@ def raise_entry(entry: _FaultEntry, site: str) -> None:
         raise InjectedBackendDown(msg)
     if entry.kind == "deadline-exceeded":
         raise InjectedDeadlineExceeded(msg)
+    if entry.kind == "peer-dead":
+        _die_as_peer(site, msg)
+    raise FaultInjected(msg)
+
+
+# ------------------------------------------------------- peer-dead injection
+#: this process's rank for fault-plan ``rank`` targeting (stamped by the
+#: communication bootstrap; None = entries without a rank match everything)
+_fault_rank: Optional[int] = None
+
+#: hook the supervision plane registers so a peer-dead firing stops this
+#: process's heartbeats BEFORE exiting (the realistic crash shape: silence,
+#: then absence); tests may stub it
+_peer_dead_hook: Optional[Callable[[], None]] = None
+
+#: the exit primitive — ``os._exit`` so no atexit handler (the clean-departure
+#: marker above all) can soften the simulated crash; tests monkeypatch this
+#: to observe the firing without dying
+_peer_dead_exit: Callable[[int], None] = os._exit
+
+#: exit status of a peer-dead firing, distinguishable in launcher logs
+PEER_DEAD_EXIT_STATUS = 43
+
+
+def set_fault_rank(rank: Optional[int]) -> None:
+    """Stamp this process's rank for ``rank``-targeted fault-plan entries
+    (the communication bootstrap calls this with ``jax.process_index()``)."""
+    global _fault_rank
+    with _lock:
+        _fault_rank = None if rank is None else int(rank)
+
+
+def _die_as_peer(site: str, msg: str) -> None:
+    """The ``peer-dead`` fault kind: this rank stops heartbeating and exits
+    abruptly — the deterministic stand-in for SIGKILL, so supervision paths
+    are testable single-host and in chaos CI without real process murder.
+    Does not return; when a test stubs ``_peer_dead_exit``, the firing
+    surfaces as :class:`FaultInjected` instead of silently continuing."""
+    _record_event(site, "peer-dead", msg)
+    hook = _peer_dead_hook
+    if hook is not None:
+        hook()
+    _peer_dead_exit(PEER_DEAD_EXIT_STATUS)
     raise FaultInjected(msg)
 
 
@@ -827,6 +962,21 @@ def atomic_write(path: str, writer: Callable[[str], Any], *, site: str = "io.wri
         return result
 
     return pol.run(site, attempt)
+
+
+# ------------------------------------------------------------- supervision
+def run_supervised(step_fn, manager, policy=None, **kwargs):
+    """Run a training loop under the supervision plane with elastic restart
+    from checkpoint — the recovery half of the typed failure vocabulary
+    above. Delegates to :func:`heat_tpu.core.supervision.run_supervised`
+    (see there for the full contract): on :class:`PeerFailed` /
+    :class:`CollectiveTimeout` / :class:`CoordinationTimeout` the harness
+    drains the scheduler, re-initializes the distributed runtime at the
+    surviving world size, restores the latest ``CheckpointManager`` step via
+    reshard-on-restore, and resumes under ``policy``'s restart budget."""
+    from . import supervision
+
+    return supervision.run_supervised(step_fn, manager, policy, **kwargs)
 
 
 # ------------------------------------------------------------------ reporting

@@ -272,6 +272,14 @@ _AUTO_DUMP_KINDS = frozenset({
     "data-loss",      # donated buffer invalidated by a failed call
     "drain-timeout",  # DispatchScheduler.drain could not flush
     "swap-failed",    # a model hot-swap rolled back (ht.serving.swap_state)
+    # supervision-plane aborts (ISSUE 14): every typed abort ships its
+    # post-mortem (the watchdog additionally dumps its own
+    # `supervision.watchdog` trigger before posting the sentinel)
+    "peer-failed",           # a peer stopped heartbeating past the budget
+    "collective-timeout",    # the collective watchdog flagged a stuck window
+    "coordination-timeout",  # a supervised coordination wait exhausted
+    "peer-dead",             # the injected peer-death fault fired (this rank)
+    "peer-failover",         # a serving pool shed typed after a peer failure
 })
 
 

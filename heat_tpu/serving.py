@@ -41,7 +41,7 @@ import time
 from typing import Any, Optional
 
 from .core import checkpoint as _checkpoint
-from .core import diagnostics, profiler, resilience, telemetry
+from .core import diagnostics, profiler, resilience, supervision, telemetry
 from .core.resilience import SwapFailed
 
 __all__ = ["ModelPool", "SwapFailed", "swap_state"]
@@ -71,6 +71,7 @@ class ModelPool:
         self._ledger: list = []
         self._swaps = 0
         self._rollbacks = 0
+        self._failovers = 0
 
     @property
     def state(self) -> Any:
@@ -117,9 +118,84 @@ class ModelPool:
 
     def swap_ledger(self) -> list:
         """Every attempted swap, oldest first: ``{t, ok, from, to, drain_s,
-        total_s}`` plus ``stage``/``error`` for rollbacks."""
+        total_s}`` plus ``stage``/``error`` for rollbacks (peer-failover
+        entries carry ``kind: "peer-failover"`` instead of from/to)."""
         with self._lock:
             return [dict(e) for e in self._ledger]
+
+    @staticmethod
+    def _forget_failed_peer(exc: BaseException) -> None:
+        # which rank died: the typed error names it (PeerFailed.rank), a
+        # watchdog/coordination abort may only carry it in the sentinel
+        rank = getattr(exc, "rank", None)
+        if rank is None:
+            payload = supervision.aborted()
+            if payload is not None:
+                rank = payload.get("rank")
+        if rank is not None:
+            supervision.forget_peer(int(rank))
+
+    def on_peer_failure(self, exc: BaseException, *,
+                        drain_timeout_s: float = 5.0, scheduler=None) -> dict:
+        """A peer process failed while this host was serving (a typed
+        :class:`~heat_tpu.core.resilience.PeerFailed` /
+        ``CollectiveTimeout`` surfaced, or the supervision sentinel is up):
+        fail the pool OVER instead of letting it wedge. The dispatch
+        scheduler is quiesced — once the abort sentinel is installed, its
+        supervision checkpoint sheds every queued item with the typed error
+        pre-dispatch, and a timed-out drain sheds the rest typed
+        (``DrainTimeout``'s contract) — then the sentinel is cleared and
+        admission reopens: the pool keeps serving this host's generation at
+        the surviving capacity, and ``admitted + shed + failed == offered``
+        holds across the failure with zero untyped errors
+        (``benchmarks/serving/failover_gate.py`` gates exactly that).
+
+        This is the single-host half of serving elasticity; a multi-host
+        deployment pairs it with ``supervision.elastic_restart`` +
+        :meth:`load` to rebuild state on the surviving world. Returns the
+        ledger entry."""
+        t0 = time.monotonic()
+        cause = f"{type(exc).__name__}: {exc}"
+        sched = scheduler if scheduler is not None else _scheduler()
+        shed_at_drain = 0
+        try:
+            # tolerate_shed: a timed-out drain has already shed everything
+            # typed, and the body MUST still run before reopen — clearing
+            # the sentinel after admission reopened would shed freshly
+            # admitted requests on the stale abort
+            with sched.quiesce(drain_timeout_s, tolerate_shed=True):
+                # inside the quiesce window (admission closed): the failed
+                # peer is marked handled FIRST (or the monitor would just
+                # re-detect the same silent rank and re-post), then the
+                # sentinel is cleared — no request admitted after reopen can
+                # observe the stale abort
+                self._forget_failed_peer(exc)
+                supervision.reset_abort()
+        except resilience.DrainTimeout as drain_exc:
+            shed_at_drain = len(drain_exc.undelivered)
+        entry = {
+            "t": time.time(), "ok": True, "kind": "peer-failover",
+            "cause": cause, "shed_at_drain": shed_at_drain,
+            "generation": self._generation,
+            "total_s": round(time.monotonic() - t0, 6),
+        }
+        with self._lock:
+            self._ledger.append(entry)
+            self._failovers += 1
+            total = self._failovers
+        diagnostics.record_resilience_event(
+            "serving.pool", "peer-failover",
+            f"pool={self.name} cause={cause} shed_at_drain={shed_at_drain}",
+        )
+        if diagnostics._enabled:
+            diagnostics.counter("serving.peer_failover")
+        if profiler._active:
+            profiler.record_counter("lifecycle.peer_failover", total)
+        telemetry.flight_record(
+            "lifecycle", "serving.pool",
+            f"pool={self.name} failover after {cause}", kind="peer-failover",
+        )
+        return dict(entry)
 
 
 def swap_state(

@@ -1,0 +1,208 @@
+"""Multi-controller supervision worker: the kill-a-rank proof (ISSUE 14).
+
+Launched by tests/test_multiprocess.py with
+``python _mp_supervision_worker.py <coordinator> <num_processes> <process_id>
+<tmpdir>``. One SPMD process of an N-process supervised training job driven
+by ``ht.resilience.run_supervised``:
+
+1. Every rank runs deterministic training steps, checkpointing each step
+   through a shared :class:`CheckpointManager` (the save's coordination
+   collectives are the supervised, sentinel-abortable waits).
+2. The LAST rank dies abruptly at its 4th step via the deterministic
+   ``peer-dead`` fault kind — ``os._exit`` with no departure marker, the
+   in-process stand-in for SIGKILL.
+3. Every survivor must raise typed ``resilience.PeerFailed`` naming the dead
+   rank within the supervision budget (heartbeat timeout + one sentinel poll
+   chunk — asserted against a hard bound here, and the whole test is
+   timeout-bounded by the launcher: NO HANG, the acceptance shape).
+4. ``run_supervised`` then performs the elastic restart: drains, abandons the
+   dead generation's runtime, negotiates a fresh coordinator over the old KV
+   store (lowest surviving rank hosts), re-initializes at world N-1, restores
+   the last committed step through the reshard-on-restore path (a P=N
+   checkpoint onto P=N-1), verifies the restored state BIT-IDENTICAL to the
+   pre-kill save, and resumes to completion.
+
+Prints ``SUPERVISION_OK <pid>`` on success; the dead rank exits with
+``resilience.PEER_DEAD_EXIT_STATUS`` and prints nothing. Any assertion
+failure exits non-zero and fails the parent test.
+"""
+
+import os
+import socket
+import sys
+import time
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def main() -> None:
+    coordinator, nprocs, pid, tmpdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ["HEAT_TPU_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["HEAT_TPU_NUM_PROCESSES"] = str(nprocs)
+    os.environ["HEAT_TPU_PROCESS_ID"] = str(pid)
+    # fast supervision budgets: detection must land well inside the test's
+    # wall-clock bound (the real default is 60 s)
+    os.environ["HEAT_TPU_PEER_TIMEOUT_S"] = "2"
+    os.environ["HEAT_TPU_COORD_TIMEOUT_MS"] = "60000"
+    os.environ["HEAT_TPU_FLIGHT_DIR"] = os.path.join(tmpdir, "flight")
+
+    import numpy as np
+
+    import heat_tpu as ht
+    import jax
+    from heat_tpu.core import checkpoint, resilience, supervision
+
+    assert jax.process_count() == nprocs
+    assert supervision.armed(), "supervision must auto-arm on a multi-process job"
+
+    dead_rank = nprocs - 1
+    max_steps = 5
+    kill_step = 3  # the dead rank exits at this step's start (fault call 4)
+    rows, cols = 4 * nprocs, 3
+    base = np.arange(rows * cols, dtype=np.float32).reshape(rows, cols)
+
+    manager = checkpoint.CheckpointManager(
+        os.path.join(tmpdir, "sup_ckpt"), max_to_keep=max_steps + 1
+    )
+
+    def host_value(step: int) -> np.ndarray:
+        return base + np.float32(step + 1)
+
+    def template():
+        # built fresh per restore: after the elastic restart the template must
+        # pin the SURVIVING world's communicator, not the dead generation's
+        return {"w": ht.zeros((rows, cols), split=0), "step": np.int64(0)}
+
+    # the deterministic rank killer: the 4th firing of train.step on the last
+    # rank stops heartbeating and exits (no departure marker — a crash shape)
+    resilience.arm_fault_plan([{
+        "site": "train.step", "kind": "peer-dead",
+        "on_call": kill_step + 1, "rank": dead_rank,
+    }])
+
+    step_t0 = {"t": None}
+
+    def step_fn(step, state):
+        step_t0["t"] = time.monotonic()
+        resilience.maybe_fault("train.step")  # rank N-1 dies here at kill_step
+        # host-side deterministic compute + re-ingest (this container's CPU
+        # backend cannot run multiprocess XLA computations; construction and
+        # the checkpoint coordination path are the multi-controller surface
+        # under test, like tests/_mp_ckpt_worker.py)
+        w = ht.array(host_value(step), split=0)
+        if step >= kill_step:
+            # give the monitor's detection a head start over the save's
+            # coordination wait so survivors spend the wait already doomed —
+            # the wait itself must deliver the typed error mid-block
+            time.sleep(0.5)
+        return {"w": w, "step": np.int64(step)}
+
+    failure = {}
+
+    def reinit(exc):
+        # the elasticity policy: survivors negotiate a fresh coordinator over
+        # the DEAD generation's still-live KV store (rank 0 hosts it and rank
+        # 0 survives here), then re-initialize at world N-1
+        failure["t_detect_s"] = time.monotonic() - step_t0["t"]
+        failure["exc"] = exc
+        assert isinstance(exc, resilience.PeerFailed), repr(exc)
+        assert exc.rank == dead_rank, f"wrong rank blamed: {exc!r}"
+        survivors = [r for r in range(nprocs) if r != exc.rank]
+        assert pid in survivors
+        new_rank = survivors.index(pid)
+        co = supervision.default_coordinator()
+        key = "heat_tpu/test/reinit/addr"
+        if new_rank == 0:
+            addr = f"localhost:{_free_port()}"
+            co.set(key, addr, True)
+        else:
+            addr = supervision.kv_wait(key, 30_000, site="test.reinit",
+                                       coordinator=co)
+        return {
+            "coordinator_address": addr,
+            "num_processes": len(survivors),
+            "process_id": new_rank,
+        }
+
+    if pid == dead_rank:
+        # this rank never returns from step kill_step's maybe_fault; if the
+        # injection failed to fire, exit distinguishably so the parent sees it
+        resilience.run_supervised(
+            step_fn, manager, template=template,
+            state=template(), start_step=0, max_steps=max_steps, save_every=1,
+        )
+        print(f"PEER_DEAD_DID_NOT_FIRE {pid}", flush=True)
+        sys.exit(7)
+
+    out = resilience.run_supervised(
+        step_fn, manager, template=template,
+        state=template(), start_step=0, max_steps=max_steps, save_every=1,
+        drain_timeout_s=5.0, reinit=reinit,
+    )
+
+    # --- typed delivery within the budget -----------------------------------
+    assert out["restarts"] == 1, out
+    exc = failure["exc"]
+    detect = failure["t_detect_s"]
+    # budget: peer timeout (2 s) + monitor tick + one sentinel-poll chunk
+    # (2 s) + slack; a hang would blow the launcher's hard timeout anyway
+    assert detect < 20.0, f"typed delivery took {detect:.1f}s"
+    print(f"TYPED PeerFailed rank={exc.rank} after {detect:.2f}s", flush=True)
+
+    # --- the survivors now ARE the world ------------------------------------
+    import jax as jax2  # re-read after re-init
+
+    assert jax2.process_count() == nprocs - 1, jax2.process_count()
+    assert len(jax2.devices()) == nprocs - 1, jax2.devices()
+    # a 2-process job restarts into a single-process world, where the plane
+    # idles by design (nothing to supervise); larger worlds stay armed
+    assert supervision.armed() or nprocs - 1 == 1
+
+    # --- restored state bit-identical to the pre-kill save ------------------
+    # the restart restored step kill_step-1 (the last step every rank
+    # committed) written at P=nprocs onto the P=nprocs-1 world: verify a
+    # fresh restore of that step byte-for-byte against the deterministic
+    # pre-kill value. Compared per addressable shard of the PADDED physical
+    # (`.larray` slices a non-addressable array — an XLA computation this
+    # container's CPU backend cannot run, like tests/_mp_ckpt_worker.py):
+    # real rows must match exactly, pad rows must hold zeros (the
+    # pads-always-zero contract survives the reshard)
+    def assert_matches(arr, ref: np.ndarray) -> None:
+        for s in arr.parray.addressable_shards:
+            data = np.asarray(s.data)
+            r0 = s.index[0].start or 0
+            for i in range(data.shape[0]):
+                row = r0 + i
+                if row < ref.shape[0]:
+                    np.testing.assert_array_equal(data[i], ref[row])
+                else:
+                    np.testing.assert_array_equal(
+                        data[i], np.zeros_like(data[i])
+                    )
+
+    restored = manager.restore(template(), step=kill_step - 1)
+    assert_matches(restored["w"], host_value(kill_step - 1))
+    assert int(restored["step"]) == kill_step - 1
+
+    # --- and the resumed run finished the job -------------------------------
+    assert out["steps"] == max_steps, out
+    assert_matches(out["state"]["w"], host_value(max_steps - 1))
+
+    # --- the failure shipped a post-mortem ----------------------------------
+    import glob
+
+    dumps = glob.glob(os.path.join(tmpdir, "flight", "*.json"))
+    assert dumps, "no flight-recorder post-mortem after the peer failure"
+
+    print(f"SUPERVISION_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,9 @@ _DIVERGENCE_WORKER = os.path.join(
 _CKPT_WORKER = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "_mp_ckpt_worker.py"
 )
+_SUPERVISION_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "_mp_supervision_worker.py"
+)
 
 
 def _free_port() -> int:
@@ -100,6 +103,34 @@ def test_multiprocess_checkpoint_v2(nprocs, devices_per_proc, tmp_path):
     for i, (rc, out) in enumerate(outs):
         assert rc == 0, f"worker {i} failed (rc={rc}):\n{out[-4000:]}"
         assert f"CKPT_OK {i}" in out, f"worker {i} incomplete:\n{out[-4000:]}"
+
+
+@pytest.mark.parametrize("nprocs,devices_per_proc", [(2, 1), (4, 1)])
+def test_multiprocess_supervision(nprocs, devices_per_proc, tmp_path):
+    """ISSUE 14, the kill-a-rank proof: the last rank of an N-process
+    supervised training job dies abruptly (deterministic ``peer-dead`` fault
+    — os._exit, no departure marker) mid-run. Every survivor must raise
+    typed ``PeerFailed`` naming the dead rank within the supervision budget
+    (never a hang — this test is bounded by the launcher timeout), dump a
+    flight-recorder post-mortem, and ``run_supervised`` must resume from the
+    last committed checkpoint at the surviving world size with restored
+    state bit-identical to the pre-kill save."""
+    from heat_tpu.core import resilience
+
+    outs = _launch(nprocs, devices_per_proc, str(tmp_path),
+                   worker=_SUPERVISION_WORKER)
+    for i, (rc, out) in enumerate(outs):
+        if i == nprocs - 1:
+            assert rc == resilience.PEER_DEAD_EXIT_STATUS, (
+                f"rank {i} should have died peer-dead (rc={rc}):\n{out[-4000:]}"
+            )
+            assert "SUPERVISION_OK" not in out
+        else:
+            assert rc == 0, f"survivor {i} failed (rc={rc}):\n{out[-4000:]}"
+            assert f"SUPERVISION_OK {i}" in out, (
+                f"survivor {i} incomplete:\n{out[-4000:]}"
+            )
+            assert "TYPED PeerFailed rank=" + str(nprocs - 1) in out
 
 
 @pytest.mark.parametrize("nprocs,devices_per_proc", [(2, 2), (4, 1)])

@@ -136,6 +136,80 @@ class TestSwap(TestCase):
         self.assertTrue(set(results) <= valid, set(results) - valid)
         self.assertEqual(self._serve(), 3.0 * self.base)
 
+    def test_quiesce_tolerate_shed_runs_body_closed_then_reraises(self):
+        """``tolerate_shed=True``: a timed-out drain (everything already
+        shed typed) must still run the critical section INSIDE the closed
+        window — the peer-failover sentinel clear depends on it — and the
+        DrainTimeout re-raises on exit for the caller's accounting."""
+        sched = _executor._get_scheduler()
+        with sched._cv:
+            sched._active += 1  # park a fake in-flight execution
+        ran = []
+        try:
+            with self.assertRaises(resilience.DrainTimeout):
+                with sched.quiesce(0.2, tolerate_shed=True):
+                    ran.append(sched.draining())
+        finally:
+            with sched._cv:
+                sched._active -= 1
+                sched._cv.notify_all()
+        self.assertEqual(ran, [True], "body must run while still closed")
+        self.assertFalse(sched.draining(), "quiesce must reopen after exit")
+        # default behaviour unchanged: the body is skipped on a timeout
+        with sched._cv:
+            sched._active += 1
+        try:
+            with self.assertRaises(resilience.DrainTimeout):
+                with sched.quiesce(0.2):
+                    self.fail("body must not run on an intolerant timeout")
+        finally:
+            with sched._cv:
+                sched._active -= 1
+                sched._cv.notify_all()
+        self.assertFalse(sched.draining())
+
+    def test_on_peer_failure_drain_timeout_clears_sentinel_before_reopen(self):
+        """The failover ordering contract: even when the drain times out,
+        the abort sentinel is cleared while admission is STILL closed, so
+        no request admitted after reopen can be shed on the stale abort."""
+        from heat_tpu.core import supervision
+
+        sched = _executor._get_scheduler()
+        supervision.arm(supervision.LocalCoordinator(), rank=0, nprocs=2,
+                        start_thread=False)
+        try:
+            supervision.post_abort("peer-failed", rank=1, last_seen_s=1.0)
+            observed = []
+            orig_reopen_check = sched.draining
+
+            def spying_reset(_real=supervision.reset_abort):
+                observed.append(("reset", orig_reopen_check()))
+                _real()
+
+            with sched._cv:
+                sched._active += 1  # the drain cannot flush: DrainTimeout
+            real_reset = supervision.reset_abort
+            supervision.reset_abort = spying_reset
+            try:
+                entry = self.pool.on_peer_failure(
+                    resilience.PeerFailed(1, 1.0, detected_by=0),
+                    drain_timeout_s=0.2, scheduler=sched,
+                )
+            finally:
+                supervision.reset_abort = real_reset
+                with sched._cv:
+                    sched._active -= 1
+                    sched._cv.notify_all()
+            self.assertEqual(observed, [("reset", True)],
+                             "sentinel must clear while still draining")
+            self.assertTrue(entry["ok"])
+            self.assertIsNone(supervision.aborted())
+            self.assertFalse(sched.draining())
+            self.assertEqual(self._serve(), self.base)  # pool serves on
+        finally:
+            supervision.disarm()
+            supervision.reset_abort()
+
     def test_quiesce_reopens_on_body_failure(self):
         sched = _executor._get_scheduler()
         with self.assertRaises(RuntimeError):
