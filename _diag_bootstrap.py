@@ -48,6 +48,25 @@ _DIAG = None
 _RESILIENCE = None
 
 
+def ensure_compile_cache_dir():
+    """The ``HEAT_TPU_COMPILE_CACHE`` knob for the driver entry points
+    (stdlib-only: no jax here). When set, the directory is created up front
+    so the first compile of the run can persist, and the path is returned;
+    the actual ``jax.config`` wiring (``jax_compilation_cache_dir`` + the
+    zero-threshold persistence knobs) happens inside the package at import
+    via ``heat_tpu.core._compile_cache`` — memoised, re-read at
+    ``ht.reload_env_knobs()``. Returns None (knob unset or dir uncreatable —
+    the cache degrades to off, never blocks a run) otherwise."""
+    path = os.environ.get("HEAT_TPU_COMPILE_CACHE")
+    if not path:
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return None  # unreachable dir: jax will warn; the run proceeds uncached
+    return path
+
+
 def load_resilience():
     """The ``heat_tpu.core.resilience`` module as a standalone instance (one per
     process, cached), bound to the SAME standalone diagnostics instance as

@@ -32,6 +32,10 @@ def _diagnostics():
         sys.path.insert(0, here)
     import _diag_bootstrap
 
+    # HEAT_TPU_COMPILE_CACHE (ISSUE 15): pre-create the persistent
+    # XLA compile-cache dir before anything imports jax, so the
+    # run's first compile can already persist
+    _diag_bootstrap.ensure_compile_cache_dir()
     return _diag_bootstrap.load_diagnostics()
 
 

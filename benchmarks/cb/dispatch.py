@@ -77,6 +77,10 @@ def _bootstrap(devices: int) -> None:
         "HEAT_TPU_EAGER_DISPATCH",
         "HEAT_TPU_JIT_THRESHOLD",  # an ambient warm-up threshold would time
         # the eager fallback while labelling it "executor"
+        "HEAT_TPU_SCHED_SHARDS",   # the bench measures the production
+        "HEAT_TPU_BATCH_WINDOW_US",  # default scheduler shape
+        "HEAT_TPU_EXEC_CACHE",     # artifact loads would mislabel compile_s
+        "HEAT_TPU_COMPILE_CACHE",
     ):
         env.pop(knob, None)
     flags = [

@@ -84,6 +84,10 @@ def _bootstrap(devices: int) -> None:
         "HEAT_TPU_DISPATCH_QUEUE",
         "HEAT_TPU_BATCH_MAX",
         "HEAT_TPU_SHED",
+        "HEAT_TPU_SCHED_SHARDS",
+        "HEAT_TPU_BATCH_WINDOW_US",
+        "HEAT_TPU_EXEC_CACHE",
+        "HEAT_TPU_COMPILE_CACHE",
     ):
         env.pop(knob, None)
     flags = [

@@ -1,0 +1,197 @@
+"""Sharded-scheduler serving gate: open-loop p99, N shards vs the single
+queue, at identical offered rates (ISSUE 15 tentpole (1)).
+
+The async-gate methodology, pointed at the scheduler's shard count instead of
+the executor mode.  Two arms run in ONE virtual mesh (shared compiled
+programs and workload state — the comparison measures the scheduler, not
+compile luck), with the scheduler REBUILT between arms
+(``HEAT_TPU_SCHED_SHARDS`` is a construction-time knob):
+
+1. ``HEAT_TPU_SCHED_SHARDS=1`` — the single-queue scheduler (bit-for-bit the
+   pre-sharding dispatch path).  Its measured per-workload open-loop offered
+   rates are recorded.
+2. ``HEAT_TPU_SCHED_SHARDS=<N>`` (default ``min(4, cores)``) — the sharded
+   scheduler, driven at the SAME offered rates, so the open-loop comparison
+   is queueing-theory-fair: identical arrival processes, different queue
+   discipline.
+
+Gate (``--check``), evaluated by :func:`evaluate` — the async gate's bars:
+
+- **closed-loop p50 must not regress**: sharded p50 <= single p50 x
+  ``P50_REGRESSION_MARGIN`` per workload;
+- **open-loop p99 must not regress overall**: the geometric mean of
+  per-workload ``sharded_p99 / single_p99`` ratios must be <= 1.0, and no
+  single workload may blow past ``P99_BLOWUP_MARGIN``.
+
+A failing comparison re-runs once (fresh arms, fresh offered rates); only
+failing BOTH is a red gate.  The summary lands in ``serving_baseline.json``'s
+``_shard_gate`` section for the trail.
+
+Standalone::
+
+    python benchmarks/serving/shard_gate.py --devices 8 --smoke --check
+"""
+
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from benchmarks.serving.harness import _bootstrap, run  # noqa: E402
+
+P50_REGRESSION_MARGIN = 1.30
+P99_BLOWUP_MARGIN = 1.50
+GEOMEAN_MAX = 1.0
+
+
+def _by_case(records):
+    return {(r["workload"], r["mode"]): r for r in records}
+
+
+def evaluate(records_single, records_sharded, shards, emit=print):
+    """Compare the two arms' records; returns ``(comparisons, failed)``.
+    Pure record math (no jax, no environment) so tests can drive it with
+    canned records."""
+    single = _by_case(records_single)
+    sharded = _by_case(records_sharded)
+    comparisons, failed, ratios = [], False, []
+    for (name, mode), s in sorted(single.items()):
+        if mode != "open":
+            continue
+        a = sharded.get((name, "open"))
+        closed_s = single.get((name, "closed"))
+        closed_a = sharded.get((name, "closed"))
+        if a is None or closed_s is None or closed_a is None:
+            emit(json.dumps({
+                "warning": f"shard gate: workload {name!r} missing from one "
+                "arm; not compared"
+            }))
+            continue
+        p99_ratio = a["p99_ms"] / max(s["p99_ms"], 1e-9)
+        p50_ratio = closed_a["p50_ms"] / max(closed_s["p50_ms"], 1e-9)
+        ratios.append(p99_ratio)
+        rec = {
+            "metric": f"serving_shard_gate_{name}",
+            "workload": name,
+            "shards": shards,
+            "offered_rps": s.get("offered_rps"),
+            "single_open_p99_ms": s["p99_ms"],
+            "sharded_open_p99_ms": a["p99_ms"],
+            "open_p99_ratio": round(p99_ratio, 4),
+            "single_closed_p50_ms": closed_s["p50_ms"],
+            "sharded_closed_p50_ms": closed_a["p50_ms"],
+            "closed_p50_ratio": round(p50_ratio, 4),
+        }
+        comparisons.append(rec)
+        emit(json.dumps(rec))
+        if p50_ratio > P50_REGRESSION_MARGIN:
+            failed = True
+            emit(json.dumps({
+                "error": f"{name}: sharded closed-loop p50 regressed "
+                f"{p50_ratio:.2f}x (margin {P50_REGRESSION_MARGIN}x)"
+            }))
+        if p99_ratio > P99_BLOWUP_MARGIN:
+            failed = True
+            emit(json.dumps({
+                "error": f"{name}: sharded open-loop p99 blew up "
+                f"{p99_ratio:.2f}x (margin {P99_BLOWUP_MARGIN}x)"
+            }))
+    if not ratios:
+        emit(json.dumps({"error": "shard gate: no comparable open-loop records"}))
+        return comparisons, True
+    geomean = math.exp(sum(math.log(r) for r in ratios) / len(ratios))
+    summary = {
+        "metric": "serving_shard_gate_summary",
+        "shards": shards,
+        "open_p99_geomean_ratio": round(geomean, 4),
+        "workloads": len(ratios),
+        "gate_max": GEOMEAN_MAX,
+    }
+    emit(json.dumps(summary))
+    comparisons.append(summary)
+    if geomean > GEOMEAN_MAX:
+        failed = True
+        emit(json.dumps({
+            "error": f"sharded open-loop p99 geomean ratio {geomean:.3f} > "
+            f"{GEOMEAN_MAX}: the sharded scheduler must not lose to the "
+            "single queue at the recorded offered rates"
+        }))
+    return comparisons, failed
+
+
+def _arm(shards: int):
+    from heat_tpu.core import _executor
+
+    os.environ["HEAT_TPU_SCHED_SHARDS"] = str(shards)
+    _executor.reload_env_knobs()
+    _executor.rebuild_scheduler()  # the shard knob binds at construction
+
+
+def compare(shards=None, smoke=True, requests=32, concurrency=4,
+            open_fraction=0.85, emit=print):
+    """Run both arms and return ``(comparisons, failed)``."""
+    from heat_tpu.core import _executor, profiler
+
+    shards = shards or min(4, os.cpu_count() or 1)
+    old = os.environ.get("HEAT_TPU_SCHED_SHARDS")
+    try:
+        profiler.reset()
+        _arm(1)
+        emit(json.dumps({"info": "shard gate arm 1/2: single-queue scheduler"}))
+        records_single, _ = run(
+            smoke=smoke, requests=requests, concurrency=concurrency,
+            open_fraction=open_fraction, emit=lambda s: None,
+        )
+        open_rps = {
+            r["workload"]: r["offered_rps"]
+            for r in records_single if r["mode"] == "open"
+        }
+        profiler.reset()
+        _arm(shards)
+        emit(json.dumps({"info": f"shard gate arm 2/2: {shards} shards",
+                         "offered_rps": open_rps}))
+        records_sharded, _ = run(
+            smoke=smoke, requests=requests, concurrency=concurrency,
+            open_fraction=open_fraction, open_rps=open_rps, emit=lambda s: None,
+        )
+    finally:
+        if old is None:
+            os.environ.pop("HEAT_TPU_SCHED_SHARDS", None)
+        else:
+            os.environ["HEAT_TPU_SCHED_SHARDS"] = old
+        _executor.reload_env_knobs()
+        _executor.rebuild_scheduler()
+    return evaluate(records_single, records_sharded, shards, emit=emit)
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--shards", type=int, default=None)
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--requests", type=int, default=None)
+    parser.add_argument("--concurrency", type=int, default=4)
+    parser.add_argument("--open-fraction", type=float, default=0.85)
+    parser.add_argument("--check", action="store_true",
+                        help="exit non-zero when the sharded scheduler fails "
+                        "the p50-no-regression / p99-no-loss gates")
+    args = parser.parse_args()
+    _bootstrap(args.devices)
+    requests = args.requests or (48 if args.smoke else 128)
+    _, failed = compare(
+        shards=args.shards, smoke=args.smoke, requests=requests,
+        concurrency=args.concurrency, open_fraction=args.open_fraction,
+    )
+    if failed and args.check:
+        print(json.dumps({"info": "shard gate failed once; retrying to rule "
+                          "out a single-run outlier"}))
+        _, failed = compare(
+            shards=args.shards, smoke=args.smoke, requests=requests,
+            concurrency=args.concurrency, open_fraction=args.open_fraction,
+        )
+    if args.check and failed:
+        sys.exit(1)

@@ -147,18 +147,35 @@ LOCK_POLICY: Dict[str, ModulePolicy] = {
         acquire_fns={"_lock_acquire": "_lock"},
         lock_aliases={"_tlock": "_lock"},
     ),
+    # _compile_cache.py (ISSUE 15): the memoised cache-dir knob, the lazy
+    # in-memory index, and the applied jax-cache marker mutate under the
+    # (strictly leaf) module _lock; reload() is the documented re-read point.
+    "heat_tpu.core._compile_cache": ModulePolicy(
+        locks={"_lock": {
+            "_dir", "_index", "_index_rejected", "_jax_cache_applied",
+        }},
+        relaxed=set(),
+    ),
 }
 
 CLASS_POLICY: List[ClassPolicy] = [
-    # _scheduler.DispatchScheduler: queue state + telemetry mutate under _cv
-    # ("telemetry (mutated under _cv; read via stats())"), including the
-    # ISSUE 10 lifecycle state (draining flag + shed/cancel/expiry ledger).
-    ClassPolicy(_SCHED, "DispatchScheduler", "_cv", {
-        "_queues", "_by_key", "_depth", "_active", "_paused", "_thread",
-        "_draining", "_drains",
+    # _scheduler.DispatchScheduler (ISSUE 15 sharding): only the admission /
+    # pause coordination state lives on the scheduler, under its _cv; every
+    # queue and telemetry cell moved into the per-shard class below.
+    ClassPolicy(_SCHED, "DispatchScheduler", "_gate", {
+        "_paused", "_draining", "_drains",
+    }),
+    # _scheduler._Shard: one shard's queues, batch index, depth/active,
+    # telemetry cells and lifecycle-ledger slice mutate under the shard's
+    # _cv ("Thread-safety policy" section of the module docstring); the
+    # folds at DispatchScheduler.stats() copy each cell under its own lock.
+    ClassPolicy(_SCHED, "_Shard", "_cv", {
+        "_queues", "_by_key", "_depth", "_active", "_thread",
         "queue_depth_peak", "batched_requests", "batch_width_hist",
         "submitted", "inline_runs", "queue_full_events", "drain_rejects",
-        "lifecycle", "tenant_lifecycle",
+        "stolen_batch_items", "window_holds", "window_widened",
+        "window_hold_ns", "lifecycle", "tenant_lifecycle",
+        "_gap_ewma_s", "_last_submit",
     }),
     # _executor._Stats: the cell list / retired / baseline fold under
     # _cells_lock (per-thread cells themselves are lock-free by design).

@@ -10,6 +10,9 @@ from ._executor import (
     reset_executor_stats,
     clear_executor_cache,
     reload_env_knobs,
+    executor_warmup,
+    executor_save_warmup,
+    rebuild_scheduler,
 )
 from .constants import *
 from .devices import *

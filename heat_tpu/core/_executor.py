@@ -133,7 +133,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import _scheduler, diagnostics, profiler, resilience, supervision
+from . import _compile_cache, _scheduler, diagnostics, profiler, resilience, supervision
+from ._compile_cache import executor_save_warmup, executor_warmup
 from ._scheduler import PendingValue
 
 __all__ = [
@@ -143,6 +144,9 @@ __all__ = [
     "reload_env_knobs",
     "executor_enabled",
     "async_dispatch_enabled",
+    "executor_warmup",
+    "executor_save_warmup",
+    "rebuild_scheduler",
 ]
 
 # Retrace-storm guard: per-call lambdas (now hoisted where we control them) or
@@ -315,6 +319,7 @@ class _EnvKnobs:
     __slots__ = (
         "eager_dispatch", "async_dispatch", "jit_threshold",
         "queue_bound", "batch_max", "quarantine_after", "shed",
+        "sched_shards", "batch_window_s", "exec_cache",
     )
 
     def reload(self) -> None:
@@ -331,6 +336,20 @@ class _EnvKnobs:
         self.batch_max = _int("HEAT_TPU_BATCH_MAX", 8)
         self.quarantine_after = _int("HEAT_TPU_QUARANTINE_AFTER", 3)
         self.shed = os.environ.get("HEAT_TPU_SHED") == "1"
+        # scheduler shard count (ISSUE 15): applied when the scheduler is
+        # CONSTRUCTED — an in-process change needs rebuild_scheduler()
+        self.sched_shards = _int(
+            "HEAT_TPU_SCHED_SHARDS", min(4, os.cpu_count() or 1)
+        )
+        # adaptive batch window in µs (0 = no holds, the pre-window scheduler)
+        try:
+            self.batch_window_s = max(
+                0.0, int(os.environ.get("HEAT_TPU_BATCH_WINDOW_US", "0")) * 1e-6
+            )
+        except ValueError:
+            self.batch_window_s = 0.0
+        # persistent per-signature compile-cache directory (None = off)
+        self.exec_cache = os.environ.get("HEAT_TPU_EXEC_CACHE") or None
 
 
 _knobs = _EnvKnobs()
@@ -347,10 +366,14 @@ def reload_env_knobs() -> None:
     call to this function (or to :func:`clear_executor_cache`, which re-reads
     as part of dropping the program table). The supervision plane's memoised
     knobs (``HEAT_TPU_SUPERVISION`` / ``PEER_TIMEOUT_S`` /
-    ``COLLECTIVE_TIMEOUT_S`` / ``COORD_TIMEOUT_MS``) re-read here too, so one
-    call covers the whole framework."""
+    ``COLLECTIVE_TIMEOUT_S`` / ``COORD_TIMEOUT_MS``) and the compile-cache
+    knobs (``HEAT_TPU_EXEC_CACHE`` / ``HEAT_TPU_COMPILE_CACHE``) re-read here
+    too, so one call covers the whole framework. ``HEAT_TPU_SCHED_SHARDS`` is
+    re-read but only applied when the scheduler is (re)constructed — see
+    :func:`rebuild_scheduler`."""
     _knobs.reload()
     supervision.reload_env_knobs()
+    _compile_cache.reload()
 
 
 def jit_threshold() -> int:
@@ -424,6 +447,26 @@ def shed_enabled() -> bool:
     return _knobs.shed
 
 
+def sched_shards() -> int:
+    """Dispatch-scheduler shard count (``HEAT_TPU_SCHED_SHARDS``, default
+    ``min(4, cores)``; ``1`` reproduces the single-queue scheduler exactly).
+    Memoised, and applied when the scheduler singleton is CONSTRUCTED — an
+    in-process change needs :func:`rebuild_scheduler` (benchmarks/tests) or a
+    fresh process; :func:`reload_env_knobs` alone only updates the value the
+    next construction will read."""
+    return _knobs.sched_shards
+
+
+def batch_window_s() -> float:
+    """Adaptive batch-window cap in SECONDS (``HEAT_TPU_BATCH_WINDOW_US``,
+    default 0 = no holds — today's dispatch timing exactly). When positive, a
+    shard that popped a batchable item below the batch cap may hold it up to
+    this long (EWMA-tuned down, bounded by deadline headroom) so concurrent
+    same-signature requests widen the batch. Memoised; see
+    :func:`reload_env_knobs`."""
+    return _knobs.batch_window_s
+
+
 # ------------------------------------------------------- per-buffer ownership
 # Donation epochs: the narrow invariant the global force lock actually
 # protected is "a buffer donated to one program call is never an operand of a
@@ -492,8 +535,35 @@ def _get_scheduler() -> _scheduler.DispatchScheduler:
         with _lock:
             sched = _dispatch_scheduler
             if sched is None:
-                sched = _scheduler.DispatchScheduler(_execute_batch)
+                sched = _scheduler.DispatchScheduler(
+                    _execute_batch, shards=_knobs.sched_shards
+                )
                 _dispatch_scheduler = sched
+    return sched
+
+
+def rebuild_scheduler() -> _scheduler.DispatchScheduler:
+    """Tear the scheduler singleton down and rebuild it with the CURRENT
+    memoised knobs (``HEAT_TPU_SCHED_SHARDS`` is applied at construction).
+
+    For benchmarks and tests that compare shard counts in one process
+    (``benchmarks/serving/shard_gate.py``): the old scheduler is drained
+    first — every outstanding future settles with a value or a typed error —
+    and the replacement starts fresh (telemetry zeroed). Not a hot path."""
+    global _dispatch_scheduler
+    old = _dispatch_scheduler
+    if old is not None:
+        try:
+            old.drain(timeout=30.0)
+        except resilience.DrainTimeout:
+            # leftovers were already shed with typed errors; the rebuild
+            # proceeds — nothing can strand on the abandoned scheduler
+            pass
+    with _lock:
+        _dispatch_scheduler = _scheduler.DispatchScheduler(
+            _execute_batch, shards=_knobs.sched_shards
+        )
+        sched = _dispatch_scheduler
     return sched
 
 
@@ -544,6 +614,17 @@ def executor_stats(top: int = 0) -> dict:
       executor lock (the contention the async path exists to remove).
     - ``donation_refusals`` — leaf donations the per-buffer ownership registry
       refused because another in-flight call still owned the buffer.
+
+    Sharded-scheduler counters (ISSUE 15; every scheduler tally lives in
+    per-shard cells folded exactly at report — see ``_scheduler``):
+
+    - ``sched_shards`` / ``per_shard`` — the constructed shard count and one
+      telemetry snapshot per shard (``queue_depth_peak`` at top level is the
+      SUM of per-shard peaks; each shard's own peak is in ``per_shard``).
+    - ``stolen_batch_items`` — batchable items pulled from other shards'
+      queues by cross-shard work-stealing.
+    - ``window_holds`` / ``window_widened`` / ``window_hold_ns`` — adaptive
+      batch-window activity (``HEAT_TPU_BATCH_WINDOW_US``).
 
     Request-lifecycle ledger (ISSUE 10; every shed/cancel/expiry is counted —
     nothing is silently dropped):
@@ -598,6 +679,12 @@ def executor_stats(top: int = 0) -> dict:
         stats["drain_rejects"] = sstats["drain_rejects"]
         stats["draining"] = sstats["draining"]
         stats["lifecycle_by_tenant"] = sstats["tenant_lifecycle"]
+        stats["sched_shards"] = sstats["shards"]
+        stats["per_shard"] = sstats["per_shard"]
+        stats["stolen_batch_items"] = sstats["stolen_batch_items"]
+        stats["window_holds"] = sstats["window_holds"]
+        stats["window_widened"] = sstats["window_widened"]
+        stats["window_hold_ns"] = sstats["window_hold_ns"]
     else:
         stats["queue_depth_peak"] = 0
         stats["batched_requests"] = 0
@@ -611,6 +698,12 @@ def executor_stats(top: int = 0) -> dict:
         stats["drain_rejects"] = 0
         stats["draining"] = False
         stats["lifecycle_by_tenant"] = {}
+        stats["sched_shards"] = _knobs.sched_shards
+        stats["per_shard"] = []
+        stats["stolen_batch_items"] = 0
+        stats["window_holds"] = 0
+        stats["window_widened"] = 0
+        stats["window_hold_ns"] = 0
     with _lock:
         stats["quarantined"] = dict(_quarantined)
     if top > 0:
@@ -620,7 +713,14 @@ def executor_stats(top: int = 0) -> dict:
                 for key, entry in _programs.items()
                 if entry is not UNSUPPORTED
             ]
-        progs.sort(key=lambda item: item[1].hits, reverse=True)
+        # deterministic tie order (ISSUE 15 satellite): equal-hit signatures
+        # used to come back in dict-insertion order, making warmup top-K
+        # selection and test assertions depend on dispatch history
+        progs.sort(
+            key=lambda item: (
+                -item[1].hits, item[1].label or _key_label(item[0])
+            )
+        )
         stats["top_signatures"] = [
             {
                 "label": entry.label or _key_label(key),
@@ -816,6 +916,7 @@ class _Program:
         "body", "out_shardings", "donate_index", "meta",
         "label", "hits", "compile_s", "arg_specs", "_plain", "_donating",
         "_variants", "_batched", "failures", "proven", "ewma_s",
+        "spec", "fingerprint", "aot_loaded",
     )
 
     def __init__(self, body, out_shardings, donate_index, meta):
@@ -833,6 +934,15 @@ class _Program:
         self._batched = None  # width -> jitted vmap variant (cross-request batching)
         self.failures = 0   # compile/execute failures (fallback_after_failure)
         self.proven = False  # at least one call of any variant has succeeded
+        # Persistent compile cache (ISSUE 15): ``spec`` is the JSON-able
+        # replay description the miss site captured (None when the signature
+        # cannot be described portably — ``out=`` donation, unhashable
+        # kwargs, pending leaves), ``fingerprint`` its content hash (computed
+        # lazily), ``aot_loaded`` whether the plain variant came from a
+        # deserialized cached executable instead of a fresh trace+compile.
+        self.spec = None
+        self.fingerprint = None
+        self.aot_loaded = False
         # Service-time EWMA over REPLAY dispatches (first calls are compile
         # time, not service time), the estimate behind HEAT_TPU_SHED admission
         # control. It measures host-side DISPATCH wall time — jax calls return
@@ -955,14 +1065,31 @@ class _Program:
                         keep_unused=True,
                     )
                 elif first:
-                    fn = self._plain = jax.jit(
-                        self._traced(),
-                        out_shardings=self.out_shardings,
-                        keep_unused=self.donate_index is not None,
-                    )
+                    if (
+                        self.donate_index is None
+                        and _compile_cache.armed()
+                    ):
+                        # persistent compile cache: a fingerprint-matched
+                        # serialized executable replaces trace + XLA compile
+                        # entirely (cold-start elimination); corruption is a
+                        # typed rejection inside load_program, and a miss
+                        # falls through to the normal jit build below
+                        fn = _compile_cache.load_program(self)
+                        if fn is not None:
+                            self._plain = fn
+                            self.aot_loaded = True
+                    if fn is None:
+                        fn = self._plain = jax.jit(
+                            self._traced(),
+                            out_shardings=self.out_shardings,
+                            keep_unused=self.donate_index is not None,
+                        )
                 if self.arg_specs is None:
+                    # shardings ride the specs so AOT lowering (the compile
+                    # cache's save path) compiles for the exact committed
+                    # input layouts the replay path dispatches with
                     self.arg_specs = tuple(
-                        jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
                         if isinstance(a, jax.Array) else a
                         for a in args
                     )
@@ -1086,14 +1213,19 @@ class _Program:
         return out
 
 
-def lookup(key, build: Callable[[], Any], label: Optional[str] = None) -> Optional[_Program]:
+def lookup(key, build: Callable[[], Any], label: Optional[str] = None,
+           spec: Optional[Callable[[], Optional[dict]]] = None) -> Optional[_Program]:
     """The cached :class:`_Program` for ``key``, building it on miss.
 
     ``build()`` returns either ``(body, out_shardings, donate_index, meta)`` or
     :data:`UNSUPPORTED`; both results are cached, so an eager-only signature is
     rejected in O(1) on every later call. Returns ``None`` for unsupported.
     ``label`` overrides the derived :func:`_key_label` — callers whose keys
-    carry opaque id tokens (the deferred-graph force) pass a readable one."""
+    carry opaque id tokens (the deferred-graph force) pass a readable one.
+    ``spec`` (a zero-arg callable, evaluated ONLY on a successful build — hits
+    never pay for it) returns the JSON-able replay description behind the
+    persistent compile cache and AOT warmup (``_compile_cache``), or None for
+    signatures that cannot be replayed portably."""
     # the whole lookup holds the lock: signature keys hash Python-level objects
     # (the Mesh), so even the read path could yield the GIL mid-mutation of the
     # shared OrderedDict; an uncontended RLock costs ~100 ns against a ~40 µs
@@ -1137,6 +1269,18 @@ def lookup(key, build: Callable[[], Any], label: Optional[str] = None) -> Option
         else:
             entry = _Program(*built)
             entry.label = label or _key_label(key)
+            if spec is not None:
+                try:
+                    entry.spec = spec()
+                except Exception as exc:
+                    # a spec that cannot be described is a warmup gap, never
+                    # a dispatch failure — counted, program still compiles
+                    entry.spec = None
+                    if diagnostics._enabled:
+                        diagnostics.record_fallback(
+                            "executor.warmup_spec",
+                            f"{entry.label}: {type(exc).__name__}: {exc}",
+                        )
         while len(_programs) >= _MAX_PROGRAMS:
             _programs.popitem(last=False)
         _programs[key] = entry
@@ -1185,7 +1329,17 @@ def fallback_after_failure(key, prog: "_Program", exc: BaseException,
             "deadline_expired"
             if isinstance(exc, resilience.DeadlineExceeded) else "shed"
         )
-        _get_scheduler().note_lifecycle(kind, _tenant_or_none())
+        if not getattr(exc, "_ht_ledgered", False):
+            # a rejection the scheduler already delivered (a queued staged
+            # call cancelled pre-dispatch) carries the ledgered mark — it was
+            # counted exactly once at the shard that pulled it; everything
+            # else (the in-call _lifecycle_check raises) is counted here
+            _get_scheduler().note_lifecycle(kind, _tenant_or_none())
+        return False
+    if isinstance(exc, (resilience.PeerFailed, resilience.CollectiveTimeout)):
+        # a supervision abort delivered into a queued execution: typed
+        # re-raise, no eager replay (the signature is healthy, the CLUSTER
+        # aborted) and no quarantine — the shed was ledgered at the shard
         return False
     for buf in donated:
         if isinstance(buf, jax.Array) and buf.is_deleted():
@@ -1912,6 +2066,65 @@ def _plan_builder(pl: _ForcePlan):
     return build
 
 
+def _plan_spec(pl: _ForcePlan) -> Optional[dict]:
+    """The JSON-able replay description of a fused-graph plan — the portable
+    half of the persistent compile cache (``_compile_cache``): enough to
+    rebuild an identically-shaped deferred graph in a FRESH process so AOT
+    warmup recompiles (or artifact-loads) the exact same signature before the
+    first request arrives.
+
+    Portability rule: every plan operation must be a ``jax.numpy`` function
+    resolvable by name to the SAME object (``getattr(jnp, name) is op`` —
+    what guarantees the warm process's rebuilt graph keys identically to real
+    traffic), kwargs must round-trip through JSON, and every leaf must be a
+    concrete array aval or a plain/np scalar.  Anything else returns None:
+    the signature simply is not warmup-coverable (counted as an
+    ``executor.warmup_spec`` fallback by the lookup)."""
+    import json
+
+    entries = []
+    for operation, fn_kwargs, refs in pl.plan:
+        name = getattr(operation, "__name__", None)
+        if not name or getattr(jnp, name, None) is not operation:
+            return None
+        if fn_kwargs and json.loads(json.dumps(fn_kwargs)) != fn_kwargs:
+            # must round-trip VALUE-identically (a tuple kwarg would replay
+            # as a list and key a different signature): not warmup-coverable
+            return None
+        entries.append({
+            "op": name,
+            "kwargs": dict(fn_kwargs) if fn_kwargs else {},
+            "refs": [[r[0], r[1]] for r in refs],
+        })
+    leaves = []
+    for leaf in pl.leaves:
+        if isinstance(leaf, jax.Array):
+            leaves.append({
+                "shape": list(leaf.shape), "dtype": np.dtype(leaf.dtype).str,
+            })
+        elif isinstance(leaf, PendingValue):
+            return None  # an in-flight buffer has no portable description
+        elif isinstance(leaf, (bool, int, float)):
+            leaves.append({"scalar": leaf, "py": type(leaf).__name__})
+        elif isinstance(leaf, (np.number, np.bool_)):
+            leaves.append({"scalar": leaf.item(), "np": np.dtype(leaf.dtype).str})
+        else:
+            return None
+    mesh = pl.root.comm.mesh
+    return {
+        "family": "defer",
+        "label": pl.label,
+        "entries": entries,
+        "leaves": leaves,
+        "gshape": list(pl.gshape),
+        "split": pl.split,
+        "out_idxs": list(pl.out_idxs),
+        "root_idxs": sorted(set(pl.root_idxs)),
+        "mesh": {"shape": list(mesh.devices.shape),
+                 "axes": list(mesh.axis_names)},
+    }
+
+
 def _plan_replay_eager(pl: _ForcePlan) -> list:
     """Op-by-op replay of the plan: same per-node op order, one re-mask per
     emitted value (interior pad garbage never touches logical slots), layout
@@ -2037,7 +2250,8 @@ def _force_sync_locked(roots: Tuple[Deferred, ...],
     if pl is None:
         return False
     pl.deadline = deadline
-    prog = lookup(pl.key, _plan_builder(pl), label=pl.label)
+    prog = lookup(pl.key, _plan_builder(pl), label=pl.label,
+                  spec=lambda: _plan_spec(pl))
     if prog is None:
         try:
             outs = _plan_replay_eager(pl)
@@ -2114,7 +2328,8 @@ def _force_async(roots: Tuple[Deferred, ...],
         if pl is None:
             return False
         pl.deadline = deadline
-        prog = lookup(pl.key, _plan_builder(pl), label=pl.label)
+        prog = lookup(pl.key, _plan_builder(pl), label=pl.label,
+                      spec=lambda: _plan_spec(pl))
         if prog is None:
             # warm-up / unsupported / quarantined: the op-by-op replay is the
             # execution. With all-concrete leaves run it here, still under the
@@ -2309,13 +2524,15 @@ def _force_async(roots: Tuple[Deferred, ...],
         if eligible:
             batch_key = (id(prog), tuple(scalar_fp))
 
-    if sched.try_inline():
-        # nobody else is dispatching: no handoff, no wake-up latency — the
-        # single-threaded cost of the async executor is this one try-acquire
+    token = sched.try_inline(tenant if tenant is not None else _tenant_or_none())
+    if token is not None:
+        # nobody else is dispatching on this tenant's shard: no handoff, no
+        # wake-up latency — the single-threaded cost of the async executor is
+        # this one try-acquire
         try:
             execute()
         finally:
-            sched.end_inline()
+            sched.end_inline(token)
         return True
     if tenant is None:
         tenant = _tenant_or_none()
@@ -2333,11 +2550,7 @@ def _force_async(roots: Tuple[Deferred, ...],
             # executing inline (inline execution under overload is exactly
             # the everyone-serialises collapse shedding exists to prevent).
             # Deadline-free work still runs inline: never silently dropped.
-            sched.note_lifecycle("shed", tenant)
-            fail(resilience.Shed(
-                f"dispatch queue full through backpressure; shedding "
-                f"deadline-bearing request ({pl.label})"
-            ))
+            fail(_shed_backpressure(sched, tenant, pl.label))
             return True
         # the queue stayed full through the backpressure policy: run inline —
         # slower than queued+batched, but work is never dropped
@@ -2375,6 +2588,105 @@ def _execute_batch(items) -> None:
             )
         for it in items:
             it.execute()
+
+
+def _shed_backpressure(sched, tenant, label) -> "resilience.Shed":
+    """Ledger + build the typed ``Shed`` for a queue that stayed full through
+    the whole backpressure ladder (``HEAT_TPU_SHED=1`` + a deadline-bearing
+    request): ONE definition for the fused-force and staged paths so the
+    shed condition, message, and the ledgered mark (which stops
+    :func:`fallback_after_failure` from counting the rejection twice) can
+    never diverge between them."""
+    sched.note_lifecycle("shed", tenant)
+    exc = resilience.Shed(
+        f"dispatch queue full through backpressure; shedding "
+        f"deadline-bearing request ({label})"
+    )
+    exc._ht_ledgered = True
+    return exc
+
+
+def call_staged(key, prog: _Program, x):
+    """Run a staged one-op program call (the ``l``/``r``/``c`` dispatch
+    families) through the dispatch scheduler when other work is in flight, so
+    concurrent same-signature staged dispatches batch into ONE
+    ``jax.vmap``-derived call exactly like fused forces do (ISSUE 15).
+
+    The caller's thread still observes the synchronous contract — this
+    function returns the program's result or raises exactly what a direct
+    ``prog(x)`` would — but under contention the call parks as a
+    :class:`~._scheduler.WorkItem` keyed on the program's identity, where the
+    shard drain loop (plus cross-shard work-stealing and the adaptive batch
+    window) folds it into a batch.  With async dispatch off, batching
+    disabled, or the affined shard idle (the inline fast path — one
+    try-acquire, so single-threaded staged ops/s is untouched, the dispatch
+    baseline gate's contract) this is a plain direct call.
+
+    Admission runs on the CALLER's thread before queueing — the deadline
+    contextvar lives here, not on the shard thread — via the same
+    ``_lifecycle_check`` a direct call would hit; the captured deadline rides
+    the item so the scheduler's pre-dispatch checkpoint covers the queued
+    window.  Typed lifecycle rejections delivered by the scheduler carry the
+    ledgered mark, so the wrapper's ``fallback_after_failure`` re-raises them
+    without double-counting."""
+    if not _knobs.async_dispatch or _knobs.batch_max <= 1:
+        return prog(x)
+    sched = _get_scheduler()
+    tenant = _tenant_or_none()
+    token = sched.try_inline(tenant)
+    if token is not None:
+        try:
+            return prog(x)
+        finally:
+            sched.end_inline(token)
+    deadline = None
+    if profiler._deadline_seen:
+        # one module-attribute read in deadline-free processes; raises the
+        # typed DeadlineExceeded/Shed before any queueing
+        prog._lifecycle_check()
+        deadline = profiler.current_deadline()
+    req = profiler.current_request() if profiler._active else None
+    pending = PendingValue(x.shape, x.dtype)
+
+    def fail(exc: BaseException) -> None:
+        pending.fail(exc)
+
+    def complete(outs, donation_happened: bool = True) -> None:
+        pending.fulfill(outs[0])
+
+    def execute() -> None:
+        # single-item path on a shard thread (or inline backpressure): must
+        # never raise — errors travel to the waiting wrapper via the future
+        try:
+            if deadline is not None and time.monotonic() >= deadline:
+                # pop-to-execute race the scheduler's own checkpoint can miss
+                sched.note_lifecycle("deadline_expired", tenant)
+                exc = resilience.DeadlineExceeded(
+                    f"deadline passed before dispatch "
+                    f"({prog.label or 'program'})"
+                )
+                exc._ht_ledgered = True
+                pending.fail(exc)
+                return
+            with profiler.attributed(req):
+                pending.fulfill(prog(x))
+        except BaseException as exc:
+            pending.fail(exc)
+
+    item = _scheduler.WorkItem(
+        tenant if tenant is not None else f"t{threading.get_ident()}",
+        execute, req=req, batch_key=(id(prog), ()), prog=prog, leaves=[x],
+        complete=complete, fail=fail, deadline=deadline,
+    )
+    if not _submit_with_backpressure(sched, item):
+        if _knobs.shed and deadline is not None:
+            # queue full through the whole backpressure ladder: shed the
+            # deadline-bearing staged request typed instead of serialising
+            # everyone behind it
+            raise _shed_backpressure(sched, item.tenant,
+                                     prog.label or "program")
+        return prog(x)  # inline: slower than batched, never dropped
+    return pending.resolve()
 
 
 class _QueueFull(Exception):

@@ -1,4 +1,4 @@
-"""Async dispatch scheduler: a fair bounded work queue for executor forces.
+"""Async dispatch scheduler: a sharded fair bounded work queue for executor forces.
 
 The lock-serialised executor (PRs 2-4) runs every deferred-graph force under
 one global ``RLock`` and blocks the caller until the program call returns —
@@ -9,28 +9,56 @@ donation decisions, pending-value installation) and hands the *execution* — th
 actual jitted program call, which needs no executor state — to this scheduler
 as a :class:`WorkItem`.
 
+**Sharding (ISSUE 15).** The scheduler used to be ONE drain thread behind one
+condition variable, so the serving tier's dispatch throughput stopped scaling
+at a single core.  The queue is now split into N :class:`_Shard`\\ s
+(``HEAT_TPU_SCHED_SHARDS``, default ``min(4, cores)``; the count is read when
+the executor constructs its scheduler — rebuild the scheduler, or start a new
+process, to change it), each with its own condition variable, tenant deques,
+batch-key index, and daemon drain thread.  Tenants are hash-affined to shards
+(one tenant's items always land on one shard, so per-tenant FIFO order is
+preserved), and a shard that pops a batchable item below the batch cap
+**work-steals** same-signature queued items from the other shards — so
+cross-request batching still sees every queue, while unrelated tenants drain
+on different cores without sharing a lock.  ``HEAT_TPU_SCHED_SHARDS=1``
+reproduces the single-queue scheduler's behaviour exactly.
+
 Three properties the serving harness's open-loop p99 depends on:
 
-- **Inline fast path.** A submitter that finds the queue empty and nobody
+- **Inline fast path.** A submitter whose affined shard is empty with nothing
   executing runs its item on its own thread (no handoff, no wake-up latency) —
   single-threaded workloads pay nothing for the queue's existence, and the
   dispatch ops/s baseline gates keep enforcing that.
 - **Fair bounded queue.** Under contention items park in per-tenant FIFO
   deques (tenant = the profiler's ambient request *tag*, falling back to the
-  submitting thread id) drained round-robin by one daemon scheduler thread, so
-  one chatty tenant cannot starve the rest.  The queue is bounded
-  (``HEAT_TPU_DISPATCH_QUEUE``); a full queue is backpressure, resolved by the
+  submitting thread id) drained round-robin by the shard's daemon thread, so
+  one chatty tenant cannot starve the rest.  The queue is bounded per shard
+  (``HEAT_TPU_DISPATCH_QUEUE``); a full shard is backpressure, resolved by the
   submitter through an ``ht.resilience`` policy (see
   ``_executor._submit_with_backpressure``).
 - **Cross-request signature batching.** When the popped item is batchable
-  (same program signature, identical scalar operands, no donation) the
-  scheduler collects every matching item across *all* tenant queues — N
-  concurrent requests that resolved to the same cached program become ONE
-  batched execution through a ``jax.vmap``-derived variant of that program
-  (``_Program.call_batched``), amortising the per-dispatch floor the
-  8-rotating-batch serving workloads exist to exercise.  Batch widths are
-  bucketed to powers of two (capped by ``HEAT_TPU_BATCH_MAX``) so the set of
-  compiled batch variants stays bounded.
+  (same program signature, identical scalar operands, no donation) the shard
+  collects every matching item across its tenant queues — and steals matching
+  items from the other shards — so N concurrent requests that resolved to the
+  same cached program become ONE batched execution through a
+  ``jax.vmap``-derived variant of that program (``_Program.call_batched``).
+  Same-shard widths are bucketed to powers of two (capped by
+  ``HEAT_TPU_BATCH_MAX``); a stolen batch may land between buckets, still
+  bounded by the cap, so the set of compiled batch variants stays bounded
+  either way.
+
+**Adaptive batch windows (ISSUE 15).** With ``HEAT_TPU_BATCH_WINDOW_US > 0``
+a shard that popped a batchable item below the batch cap may HOLD it briefly
+so near-simultaneous same-signature arrivals widen the batch instead of
+dispatching alone.  The hold is adaptive, not fixed: the effective window is
+``min(knob, 8 x gap-EWMA)`` where the gap-EWMA tracks the shard's inter-submit
+gap — dense traffic earns a short hold that still catches the next arrival,
+sparse traffic (EWMA above the knob, empty queue) holds not at all — and the
+hold is **bounded by deadline headroom**: an item holding a wall-clock
+deadline caps the hold at half its remaining budget minus the program's
+service-time EWMA, so a window hold can never turn a feasible request into a
+``DeadlineExceeded``.  ``HEAT_TPU_BATCH_WINDOW_US=0`` (the default) disables
+holds entirely — exactly the pre-window scheduler.
 
 :class:`PendingValue` is the dispatch-done future the executor installs into
 ``Deferred.value`` while an item is queued/in flight: ``resolve()`` blocks only
@@ -42,28 +70,50 @@ host-side graph building of other requests with device work.
 wall-clock ``deadline`` (an absolute ``time.monotonic()`` instant, captured by
 the executor from the profiler's request scope / the deferred nodes), and the
 scheduler acts on it at the two checkpoints it owns: **pre-dispatch** — an
-expired item popped by the drain loop is cancelled instead of executed, its
-futures failed with a typed ``ht.resilience.DeadlineExceeded`` (which releases
-its buffer ownership through the item's ``fail`` closure) — and **batch
-formation** — expired peers are pulled out of the batch-key index and
-cancelled rather than widening a healthy batch. Explicit lifecycle verbs:
+expired item popped by a drain loop (or found during a steal) is cancelled
+instead of executed, its futures failed with a typed
+``ht.resilience.DeadlineExceeded`` (which releases its buffer ownership
+through the item's ``fail`` closure) — and **batch formation** — expired
+peers are pulled out of the batch-key index and cancelled rather than
+widening a healthy batch. Explicit lifecycle verbs fan out over every shard
+with exactly-once ledger accounting (each rejection is counted in exactly one
+shard's cells, and the cells fold at :meth:`DispatchScheduler.stats`):
 :meth:`DispatchScheduler.cancel` fails a tenant's queued items with
-``RequestCancelled``; :meth:`DispatchScheduler.drain` stops admission, flushes
-(or, past its timeout, sheds with a raised-and-delivered ``DrainTimeout``)
-everything outstanding so no ``PendingValue`` can stay blocked forever — the
-executor registers an atexit drain for interpreter shutdown;
-:meth:`DispatchScheduler.reopen` re-opens admission after a drain.
+``RequestCancelled`` (the tenant's affined shard holds them all);
+:meth:`DispatchScheduler.drain` stops admission globally, flushes every shard
+(or, past its timeout, sheds the leftovers of every shard with ONE
+raised-and-delivered ``DrainTimeout``) so no ``PendingValue`` can stay
+blocked forever — the executor registers an atexit drain for interpreter
+shutdown; :meth:`DispatchScheduler.reopen` re-opens admission after a drain.
 
 Telemetry (surfaced through ``ht.executor_stats()`` and mirrored as
-``ht.diagnostics`` counters by the executor): ``queue_depth_peak``,
-``batched_requests`` (requests that rode a batched execution),
-``batch_width_hist`` (batch width -> count), submit/inline tallies, and the
-lifecycle ledger ``lifecycle`` (``deadline_expired`` / ``shed`` /
-``cancelled`` totals, also per tenant) — every shed/cancel/expiry is counted,
-nothing is silently dropped.  When the profiler is active every
-enqueue/dequeue records a ``queue_depth`` counter sample, exported as a
-Perfetto counter track, and every lifecycle event samples a
-``lifecycle.<kind>`` cumulative counter track.
+``ht.diagnostics`` counters by the executor): every counter lives in
+PER-SHARD cells mutated under that shard's ``_cv`` and folded exactly at
+:meth:`stats` — the same fold-at-report pattern as the executor's per-thread
+``_stats`` cells — with the per-shard breakdown preserved under
+``per_shard``.  Sums fold ``submitted`` / ``batched_requests`` /
+``queue_full_events`` / the lifecycle ledger / the window and steal counters;
+``queue_depth_peak`` folds as the sum of per-shard peaks (an upper bound on
+the instantaneous global depth — per-shard peaks are in ``per_shard``).
+When the profiler is active every enqueue/dequeue records a ``queue_depth``
+counter sample (the summed rollup across shards) plus, with more than one
+shard, a ``queue_depth.shard<i>`` sample per shard — exported as Perfetto
+counter tracks — and every lifecycle event samples a ``lifecycle.<kind>``
+cumulative counter track.
+
+Thread-safety policy (transcribed in ``analysis/rules_locks.LOCK_POLICY``):
+:class:`_Shard` state — queues, batch index, depth/active, telemetry and
+lifecycle cells, ``drain_rejects`` — is locked-exact under the shard's
+``_cv``; :class:`DispatchScheduler` admission state (``_draining`` /
+``_drains`` / ``_paused``) is locked-exact under the scheduler's ``_gate``.
+Shard loops READ ``_paused`` / ``_draining`` as relaxed snapshots; the
+admission-vs-drain decision itself is ordered by the SHARD lock
+(:meth:`_Shard.submit` checks ``_draining`` under the same ``_cv`` the
+drain's sweep takes, so no item can be admitted after its shard was swept).
+No code path ever holds two scheduler locks at once — drains, steals and
+fan-outs visit shards strictly one at a time — so the committed lock graph
+gains no intra-scheduler edges and every scheduler lock stays strictly
+below ``_executor._lock``.
 
 Stdlib-only at module load (the executor imports it lazily-cheap); all jax
 work lives in the closures the executor puts on the items.
@@ -75,6 +125,7 @@ import contextlib
 import itertools
 import threading
 import time
+import zlib
 from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -182,8 +233,9 @@ class WorkItem:
 
 
 def _bucket_width(n: int, cap: int) -> int:
-    """Largest power of two <= min(n, cap): batch widths are bucketed so each
-    program compiles at most log2(cap) batched variants."""
+    """Largest power of two <= min(n, cap): same-shard batch widths are
+    bucketed so each program compiles at most log2(cap) batched variants (a
+    cross-shard steal may top a group up between buckets — still <= cap)."""
     n = min(n, max(1, cap))
     w = 1
     while w * 2 <= n:
@@ -191,15 +243,21 @@ def _bucket_width(n: int, cap: int) -> int:
     return w
 
 
-class DispatchScheduler:
-    """The fair bounded dispatch queue plus its daemon drain thread.
+class _Shard:
+    """One queue shard: tenant deques, the batch-key index, a daemon drain
+    thread, and the shard's telemetry + lifecycle cells.
 
-    ``batch_runner(items)`` is injected by the executor (avoids an import
-    cycle): called with 2+ same-``batch_key`` items, it must fulfil every
-    item's futures itself and never raise.
+    Everything on the shard mutates under ``self._cv`` (the
+    ``_locked``-suffix convention marks helpers entered with it held);
+    :class:`DispatchScheduler` folds the cells at report time.  The only
+    cross-shard touch is work-stealing: another shard's drain thread calls
+    :meth:`steal_batchable`, which takes THIS shard's ``_cv`` alone — no two
+    scheduler locks are ever held together.
     """
 
-    def __init__(self, batch_runner: Optional[Callable[[List[WorkItem]], None]] = None):
+    def __init__(self, sched: "DispatchScheduler", index: int):
+        self.sched = sched
+        self.index = index
         self._cv = threading.Condition()
         self._queues: "OrderedDict[str, deque]" = OrderedDict()
         # batch_key -> queued batchable items (insertion order): batch
@@ -208,33 +266,35 @@ class DispatchScheduler:
         self._by_key: Dict[object, List[WorkItem]] = {}
         self._depth = 0
         self._active = 0          # executions in flight (inline + thread)
-        self._paused = False      # test hook: hold items in the queue
-        self._draining = False    # lifecycle: admission closed (drain/shutdown)
-        self._drains = 0          # drain epochs: quiesce must not reopen a later drain
-        self._seq = itertools.count(1)
         self._thread: Optional[threading.Thread] = None
-        self.batch_runner = batch_runner
-        # telemetry (mutated under _cv; read via stats())
+        # telemetry cells (mutated under _cv; folded by DispatchScheduler.stats)
         self.queue_depth_peak = 0
         self.batched_requests = 0
         self.batch_width_hist: Dict[int, int] = {}
         self.submitted = 0
         self.inline_runs = 0
         self.queue_full_events = 0
-        self.drain_rejects = 0    # submits refused because admission is closed
-        # the lifecycle ledger: every request-shaped rejection is counted here
-        # (totals + per tenant) so nothing is ever silently dropped
+        self.drain_rejects = 0        # submits refused: admission closed
+        self.stolen_batch_items = 0   # items this shard stole FROM other shards
+        self.window_holds = 0         # adaptive-window holds taken
+        self.window_widened = 0       # holds during which new peers arrived
+        self.window_hold_ns = 0       # wall ns spent holding
+        # the shard's slice of the lifecycle ledger: every request-shaped
+        # rejection is counted in exactly ONE shard's cells (totals + per
+        # tenant), and the cells fold at stats() — nothing is double-counted,
+        # nothing is silently dropped
         self.lifecycle: Dict[str, int] = {k: 0 for k in LIFECYCLE_KINDS}
         self.tenant_lifecycle: Dict[str, Dict[str, int]] = {}
+        # adaptive-window signal: EWMA of the gap between queued submits
+        # (seconds); 0 until two submits have been seen
+        self._gap_ewma_s = 0.0
+        self._last_submit: Optional[float] = None
 
     # ------------------------------------------------------------- submission
-    def try_inline(self) -> bool:
-        """Claim the inline fast path: True when the queue is empty and nothing
-        is executing — the submitter runs its item on its own thread (call
-        :meth:`end_inline` when done).  Under contention returns False and the
-        item should be queued instead."""
+    def try_inline_locked_claim(self) -> bool:
+        """Claim the shard's inline fast path (empty + idle + not paused)."""
         with self._cv:
-            if self._depth == 0 and self._active == 0 and not self._paused:
+            if self._depth == 0 and self._active == 0 and not self.sched._paused:
                 self._active += 1
                 self.inline_runs += 1
                 return True
@@ -246,18 +306,24 @@ class DispatchScheduler:
             self._cv.notify_all()
 
     def submit(self, item: WorkItem, bound: int) -> bool:
-        """Park ``item`` in its tenant's queue. False when the queue is at
-        ``bound`` (the caller applies its backpressure policy and retries or
-        executes inline) or when the scheduler is draining (admission closed:
-        the caller executes inline or sheds — work is never dropped)."""
+        """Park ``item`` in its tenant's queue; False when admission is
+        closed or this shard is at ``bound`` (the caller applies its
+        backpressure policy).
+
+        The ``_draining`` check happens HERE, under the shard's ``_cv`` —
+        the same lock the drain's sweep takes — so no item can slip in
+        after its shard was swept: a submit either enqueues before the
+        sweep (which then flushes or sheds it) or observes the flag the
+        drain set first and is refused. (The flag write itself is under the
+        scheduler ``_gate``; the read is ordered by this shard's ``_cv``.)"""
         with self._cv:
-            if self._draining:
+            if self.sched._draining:
                 self.drain_rejects += 1
                 return False
             if self._depth >= bound:
                 self.queue_full_events += 1
                 return False
-            item.seq = next(self._seq)
+            item.seq = next(self.sched._seq)
             q = self._queues.get(item.tenant)
             if q is None:
                 q = self._queues[item.tenant] = deque()
@@ -268,15 +334,18 @@ class DispatchScheduler:
             self.submitted += 1
             if self._depth > self.queue_depth_peak:
                 self.queue_depth_peak = self._depth
+            now = time.monotonic()
+            last = self._last_submit
+            self._last_submit = now
+            if last is not None:
+                gap = now - last
+                prev = self._gap_ewma_s
+                self._gap_ewma_s = gap if prev <= 0.0 else prev + 0.25 * (gap - prev)
             depth = self._depth
             self._ensure_thread_locked()
             self._cv.notify_all()
         self._note_depth(depth)
         return True
-
-    def depth(self) -> int:
-        with self._cv:
-            return self._depth
 
     # ------------------------------------------------------------- drain loop
     def _ensure_thread_locked(self) -> None:
@@ -284,7 +353,8 @@ class DispatchScheduler:
         # checker enforces for functions entered with the lock already held)
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
-                target=self._loop, name="heat-tpu-dispatch", daemon=True
+                target=self._loop, name=f"heat-tpu-dispatch-{self.index}",
+                daemon=True,
             )
             self._thread.start()
 
@@ -328,10 +398,54 @@ class DispatchScheduler:
                 return item
         return None
 
+    def _hold_window_locked(self, item: WorkItem, batch_cap: int,
+                            window_s: float) -> None:
+        """The adaptive batch window: hold a batchable ``item`` (already
+        popped) up to the effective window so same-signature arrivals widen
+        the batch. Under _cv (the wait releases it, so submits land).
+
+        The effective hold is EWMA-tuned — ``min(window_s, 8 x gap-EWMA)``,
+        and only taken under measured queue pressure (more work already
+        queued, or arrivals dense enough that the window can realistically
+        catch the next one) — and bounded by the item's deadline headroom:
+        never more than half the remaining budget after the program's
+        service-time EWMA, so a hold cannot expire a feasible request."""
+        key = item.batch_key
+        gap = self._gap_ewma_s
+        if not (self._depth > 0 or (0.0 < gap <= window_s)):
+            return  # no pressure: holding would only add latency
+        eff = window_s if gap <= 0.0 else min(window_s, 8.0 * gap)
+        if item.deadline is not None:
+            est = item.prog.ewma_s if item.prog is not None else 0.0
+            headroom = item.deadline - time.monotonic() - est
+            if headroom <= 0.0:
+                return  # no headroom to spend: dispatch immediately
+            eff = min(eff, headroom * 0.5)
+        if eff <= 0.0:
+            return
+        before = len(self._by_key.get(key, ()))
+        if before + 1 >= batch_cap:
+            return  # already enough peers queued to fill the batch
+        self.window_holds += 1
+        t0 = time.monotonic()
+        hold_until = t0 + eff
+        while True:
+            now = time.monotonic()
+            if now >= hold_until:
+                break
+            if self.sched._draining or self.sched._paused:
+                break  # a drain/pause wants the queue settled, not held
+            if len(self._by_key.get(key, ())) + 1 >= batch_cap:
+                break  # the batch is full: no reason to keep holding
+            self._cv.wait(hold_until - now)
+        self.window_hold_ns += int((time.monotonic() - t0) * 1e9)
+        if len(self._by_key.get(key, ())) > before:
+            self.window_widened += 1
+
     def _pop_group_locked(
-        self, batch_cap: int, now: float
+        self, batch_cap: int, now: float, window_s: float = 0.0
     ) -> Tuple[List[WorkItem], List[WorkItem]]:
-        """Round-robin tenant pop + cross-tenant batch collection, with the
+        """Round-robin tenant pop + same-shard batch collection, with the
         pre-dispatch deadline checkpoint: items whose deadline has passed are
         pulled out and returned separately (``expired``) instead of being
         executed or widening the batch — the caller fails their futures
@@ -348,6 +462,11 @@ class DispatchScheduler:
             break
         group = [item]
         if item.batch_key is not None and batch_cap > 1:
+            if window_s > 0.0:
+                # adaptive batch window: wait (bounded) for same-signature
+                # arrivals before forming the batch
+                self._hold_window_locked(item, batch_cap, window_s)
+                now = time.monotonic()
             # gather same-signature items from EVERY tenant queue (this is the
             # cross-request half of signature batching) via the batch-key
             # index, oldest first — no full-queue scan under the lock. Expired
@@ -368,10 +487,39 @@ class DispatchScheduler:
             group.extend(take)
         return group, expired
 
+    def steal_batchable(
+        self, batch_key, need: int, now: float
+    ) -> Tuple[List[WorkItem], List[WorkItem], int]:
+        """Hand up to ``need`` live queued items with ``batch_key`` (oldest
+        first) to ANOTHER shard's drain thread, pulling expired peers out of
+        the queue as a side effect — the pre-dispatch deadline checkpoint
+        applies to stolen work too. Expired items are ledgered HERE (the
+        shard that owned them — exactly-once accounting); the caller delivers
+        their typed errors outside every lock. Returns
+        ``(live, expired, depth_after)``."""
+        live: List[WorkItem] = []
+        expired: List[WorkItem] = []
+        with self._cv:
+            matches = sorted(self._by_key.get(batch_key, ()), key=lambda w: w.seq)
+            for w in matches:
+                if w.expired(now):
+                    self._remove_item_locked(w)
+                    self._count_lifecycle_locked("deadline_expired", w.tenant)
+                    expired.append(w)
+                elif len(live) < need:
+                    self._remove_item_locked(w)
+                    live.append(w)
+            depth = self._depth
+            if live or expired:
+                self._cv.notify_all()
+        if live or expired:
+            self._note_depth(depth)
+        return live, expired, depth
+
     def _count_lifecycle_locked(self, kind: str, tenant: Optional[str],
                                 n: int = 1) -> int:
-        """Account ``n`` lifecycle events of ``kind``; returns the new total
-        (the cumulative value behind the profiler counter track). Under _cv."""
+        """Account ``n`` lifecycle events of ``kind`` in THIS shard's cells;
+        returns the shard's new total. Under _cv."""
         self.lifecycle[kind] += n
         if tenant is not None:
             per = self.tenant_lifecycle.get(tenant)
@@ -382,79 +530,36 @@ class DispatchScheduler:
             per[kind] += n
         return self.lifecycle[kind]
 
-    def note_lifecycle(self, kind: str, tenant: Optional[str] = None,
-                       n: int = 1) -> None:
-        """Count ``n`` shed/cancelled/expired requests (the executor's
-        admission-side events route here too, so ``executor_stats()`` has ONE
-        ledger) and mirror them to diagnostics counters and the profiler's
-        cumulative ``lifecycle.<kind>`` counter track."""
-        with self._cv:
-            total = self._count_lifecycle_locked(kind, tenant, n)
-        from . import diagnostics, profiler, telemetry
-
-        if diagnostics._enabled:
-            diagnostics.counter(f"executor.{kind}", n)
-        if profiler._active:
-            profiler.record_counter(f"lifecycle.{kind}", total)
-        telemetry.flight_record(  # always-on ring: post-mortems need the tail
-            "lifecycle", f"scheduler.{kind}",
-            f"tenant={tenant or '<none>'} n={n} total={total}", kind=kind,
-        )
-
-    def _deliver_lifecycle(self, item: WorkItem, kind: str,
-                           exc: BaseException) -> None:
-        """Fail a cancelled/expired/shed item's futures with the typed error
-        (releasing its buffer ownership through the ``fail`` closure) and
-        mirror the already-ledgered event to diagnostics + the profiler
-        counter track. Never raises — this runs on the scheduler thread and
-        in drain paths. The ledger increment itself happens under _cv at the
-        site that pulled the item out of the queue."""
-        try:
-            if item.fail is not None:
-                item.fail(exc)
-        except BaseException:  # pragma: no cover - belt: a bookkeeping bug in
-            pass               # one item must not strand the rest
-        from . import diagnostics, profiler, telemetry
-
-        if diagnostics._enabled:
-            diagnostics.counter(f"executor.{kind}", 1)
-        if profiler._active:
-            # cumulative sample; the bare read of the ledger is a relaxed
-            # telemetry snapshot, not a synchronised count
-            profiler.record_counter(f"lifecycle.{kind}", self.lifecycle[kind])
-        telemetry.flight_record(
-            "lifecycle", f"scheduler.{kind}", item.describe(), kind=kind,
-        )
-
     def _loop(self) -> None:
         from . import _executor  # late: the executor imports this module first
 
+        sched = self.sched
         while True:
             with self._cv:
-                while self._depth == 0 or self._paused:
+                while self._depth == 0 or sched._paused:
                     self._cv.wait()
+                batch_cap = _executor.batch_max()
+                # active BEFORE the pop: the adaptive window inside
+                # _pop_group_locked can hold a popped (depth-decremented)
+                # item across a cv wait, and drain/wait_idle must keep
+                # seeing the shard as busy for that whole stretch — a
+                # quiesced hot-swap may not overlap a held item's dispatch
+                self._active += 1
                 group, expired = self._pop_group_locked(
-                    _executor.batch_max(), time.monotonic()
+                    batch_cap, time.monotonic(), _executor.batch_window_s()
                 )
                 if expired:
                     for w in expired:
                         self._count_lifecycle_locked("deadline_expired", w.tenant)
-                if group:
-                    self._active += 1
-                    if len(group) > 1:
-                        width = len(group)
-                        self.batched_requests += width
-                        self.batch_width_hist[width] = (
-                            self.batch_width_hist.get(width, 0) + 1
-                        )
-                else:
+                if not group:
                     # everything popped this round had expired: wake wait_idle
                     # / drain waiters watching the depth we just lowered
+                    self._active -= 1
                     self._cv.notify_all()
                 depth = self._depth
             self._note_depth(depth)
             for w in expired:
-                self._deliver_lifecycle(
+                sched._deliver_lifecycle(
                     w, "deadline_expired",
                     resilience.DeadlineExceeded(
                         f"deadline passed while queued ({w.describe()})"
@@ -462,6 +567,47 @@ class DispatchScheduler:
                 )
             if not group:
                 continue
+            # ---- cross-shard work-stealing: top a batchable group up, OWN
+            # queue first (the bucketed gather stopped at a power of two; a
+            # steal-widened batch takes the rest, and the oldest local peers
+            # must not be left behind while remote ones are taken), then the
+            # other shards — one shard lock at a time, never two
+            if (
+                group[0].batch_key is not None
+                and len(group) < batch_cap
+                and len(sched._shards) > 1
+            ):
+                need = batch_cap - len(group)
+                now = time.monotonic()
+                stolen = 0
+                for other in (self,
+                              *(o for o in sched._shards if o is not self)):
+                    if need <= 0:
+                        break
+                    live, exp, _ = other.steal_batchable(
+                        group[0].batch_key, need, now
+                    )
+                    group.extend(live)
+                    need -= len(live)
+                    if other is not self:
+                        stolen += len(live)
+                    for w in exp:
+                        sched._deliver_lifecycle(
+                            w, "deadline_expired",
+                            resilience.DeadlineExceeded(
+                                f"deadline passed while queued ({w.describe()})"
+                            ),
+                        )
+                if stolen:
+                    with self._cv:
+                        self.stolen_batch_items += stolen
+            if len(group) > 1:
+                with self._cv:
+                    width = len(group)
+                    self.batched_requests += width
+                    self.batch_width_hist[width] = (
+                        self.batch_width_hist.get(width, 0) + 1
+                    )
             if supervision is not None and supervision._armed:
                 # the scheduler's supervision checkpoint: once the abort
                 # sentinel is up, queued work is SHED typed (PeerFailed /
@@ -476,13 +622,13 @@ class DispatchScheduler:
                         self._active -= 1
                         self._cv.notify_all()
                     for w in group:
-                        self._deliver_lifecycle(w, "shed", abort)
+                        sched._deliver_lifecycle(w, "shed", abort)
                     continue
             try:
                 if len(group) == 1:
                     group[0].execute()
                 else:
-                    self.batch_runner(group)
+                    sched.batch_runner(group)
             except BaseException as exc:  # item contracts say "never raise" —
                 # this is the last-ditch guard so a bug cannot strand waiters
                 for w in group:
@@ -496,25 +642,217 @@ class DispatchScheduler:
                     self._active -= 1
                     self._cv.notify_all()
 
+    # ------------------------------------------------------------- telemetry
+    def _note_depth(self, depth: int) -> None:
+        from . import profiler
+
+        if profiler._active:
+            shards = self.sched._shards
+            if len(shards) > 1:
+                # one Perfetto counter track per shard, plus the summed
+                # rollup below (the bare cross-shard reads are a relaxed
+                # telemetry snapshot, not a synchronised count)
+                profiler.record_counter(f"queue_depth.shard{self.index}", depth)
+                total = 0
+                for sh in shards:
+                    total += depth if sh is self else sh._depth
+                profiler.record_counter("queue_depth", total)
+            else:
+                profiler.record_counter("queue_depth", depth)
+
+    def snapshot_locked_copy(self) -> dict:
+        """This shard's telemetry cells, copied under its lock (stats fold)."""
+        with self._cv:
+            return {
+                "queue_depth": self._depth,
+                "queue_depth_peak": self.queue_depth_peak,
+                "batched_requests": self.batched_requests,
+                "batch_width_hist": dict(self.batch_width_hist),
+                "submitted": self.submitted,
+                "inline_runs": self.inline_runs,
+                "queue_full_events": self.queue_full_events,
+                "drain_rejects": self.drain_rejects,
+                "stolen_batch_items": self.stolen_batch_items,
+                "window_holds": self.window_holds,
+                "window_widened": self.window_widened,
+                "window_hold_ns": self.window_hold_ns,
+                "lifecycle": dict(self.lifecycle),
+                "tenant_lifecycle": {
+                    t: dict(per) for t, per in self.tenant_lifecycle.items()
+                },
+            }
+
+    def reset_stats(self) -> None:
+        with self._cv:
+            self.queue_depth_peak = self._depth
+            self.batched_requests = 0
+            self.batch_width_hist = {}
+            self.submitted = 0
+            self.inline_runs = 0
+            self.queue_full_events = 0
+            self.drain_rejects = 0
+            self.stolen_batch_items = 0
+            self.window_holds = 0
+            self.window_widened = 0
+            self.window_hold_ns = 0
+            self.lifecycle = {k: 0 for k in LIFECYCLE_KINDS}
+            self.tenant_lifecycle = {}
+
+
+class DispatchScheduler:
+    """The sharded fair bounded dispatch queue plus its per-shard drain
+    threads.
+
+    ``batch_runner(items)`` is injected by the executor (avoids an import
+    cycle): called with 2+ same-``batch_key`` items, it must fulfil every
+    item's futures itself and never raise.  ``shards`` fixes the shard count
+    for this scheduler's lifetime (the executor passes the memoised
+    ``HEAT_TPU_SCHED_SHARDS`` knob; 1 reproduces the single-queue scheduler
+    exactly).
+    """
+
+    def __init__(self, batch_runner: Optional[Callable[[List[WorkItem]], None]] = None,
+                 shards: int = 1):
+        self._gate = threading.Condition()
+        self._paused = False      # test hook: hold items in the queues
+        self._draining = False    # lifecycle: admission closed (drain/shutdown)
+        self._drains = 0          # drain epochs: quiesce must not reopen a later drain
+        self._seq = itertools.count(1)
+        self.batch_runner = batch_runner
+        self._shards: Tuple[_Shard, ...] = tuple(
+            _Shard(self, i) for i in range(max(1, int(shards)))
+        )
+
+    @property
+    def shards(self) -> int:
+        return len(self._shards)
+
+    def _shard_for(self, affinity) -> _Shard:
+        """The shard ``affinity`` (a tenant tag, or None for untagged work)
+        is hash-affined to. Stable within a process: one tenant's items
+        always queue on one shard, preserving per-tenant FIFO order.
+        Untagged work normalises to the SAME ``t<thread-id>`` string the
+        executor uses as its fallback tenant, so an inline claim and a
+        queued item from one untagged thread always meet on one shard."""
+        shards = self._shards
+        if len(shards) == 1:
+            return shards[0]
+        if affinity is None:
+            affinity = f"t{threading.get_ident()}"
+        elif not isinstance(affinity, str):
+            affinity = f"t{affinity}"
+        idx = zlib.crc32(affinity.encode("utf-8", "surrogatepass"))
+        return shards[idx % len(shards)]
+
+    # ------------------------------------------------------------- submission
+    def try_inline(self, affinity=None) -> Optional[_Shard]:
+        """Claim the inline fast path on the affined shard: a truthy shard
+        token when that shard's queue is empty and nothing is executing there
+        — the submitter runs its item on its own thread (pass the token to
+        :meth:`end_inline` when done).  Under contention returns None and the
+        item should be queued instead."""
+        shard = self._shard_for(affinity)
+        if shard.try_inline_locked_claim():
+            return shard
+        return None
+
+    def end_inline(self, shard: Optional[_Shard] = None) -> None:
+        (shard if shard is not None else self._shards[0]).end_inline()
+
+    def submit(self, item: WorkItem, bound: int) -> bool:
+        """Park ``item`` in its tenant's affined shard. False when that shard
+        is at ``bound`` (the caller applies its backpressure policy and
+        retries or executes inline) or when the scheduler is draining
+        (admission closed: the caller executes inline or sheds — work is
+        never dropped). The draining check lives INSIDE the shard's lock —
+        see :meth:`_Shard.submit` — so admission-vs-drain stays atomic per
+        shard and the submit hot path never touches a process-global lock."""
+        return self._shard_for(item.tenant).submit(item, bound)
+
+    def depth(self) -> int:
+        total = 0
+        for sh in self._shards:
+            with sh._cv:
+                total += sh._depth
+        return total
+
     # ------------------------------------------------------------- lifecycle
+    def note_lifecycle(self, kind: str, tenant: Optional[str] = None,
+                       n: int = 1) -> None:
+        """Count ``n`` shed/cancelled/expired requests (the executor's
+        admission-side events route here too, so ``executor_stats()`` has ONE
+        ledger) in the tenant's affined shard — exactly once — and mirror
+        them to diagnostics counters and the profiler's cumulative
+        ``lifecycle.<kind>`` counter track."""
+        shard = self._shard_for(tenant)
+        with shard._cv:
+            shard._count_lifecycle_locked(kind, tenant, n)
+        from . import diagnostics, profiler, telemetry
+
+        if diagnostics._enabled:
+            diagnostics.counter(f"executor.{kind}", n)
+        if profiler._active:
+            profiler.record_counter(f"lifecycle.{kind}", self._lifecycle_total(kind))
+        telemetry.flight_record(  # always-on ring: post-mortems need the tail
+            "lifecycle", f"scheduler.{kind}",
+            f"tenant={tenant or '<none>'} n={n}", kind=kind,
+        )
+
+    def _lifecycle_total(self, kind: str) -> int:
+        # relaxed cross-shard sum: the cumulative value behind the profiler
+        # counter track is a telemetry snapshot, not a synchronised count
+        total = 0
+        for sh in self._shards:
+            total += sh.lifecycle[kind]
+        return total
+
+    def _deliver_lifecycle(self, item: WorkItem, kind: str,
+                           exc: BaseException) -> None:
+        """Fail a cancelled/expired/shed item's futures with the typed error
+        (releasing its buffer ownership through the ``fail`` closure) and
+        mirror the already-ledgered event to diagnostics + the profiler
+        counter track. Never raises — this runs on scheduler threads and
+        in drain paths. The ledger increment itself happens under a shard's
+        _cv at the site that pulled the item out of its queue."""
+        # mark the error as ledger-accounted so a waiter that re-routes it
+        # through fallback_after_failure (the staged one-op wrappers) does
+        # not count the same rejection twice
+        exc._ht_ledgered = True
+        try:
+            if item.fail is not None:
+                item.fail(exc)
+        except BaseException:  # pragma: no cover - belt: a bookkeeping bug in
+            pass               # one item must not strand the rest
+        from . import diagnostics, profiler, telemetry
+
+        if diagnostics._enabled:
+            diagnostics.counter(f"executor.{kind}", 1)
+        if profiler._active:
+            profiler.record_counter(f"lifecycle.{kind}", self._lifecycle_total(kind))
+        telemetry.flight_record(
+            "lifecycle", f"scheduler.{kind}", item.describe(), kind=kind,
+        )
+
     def cancel(self, tag: str) -> int:
         """Cancel every still-queued item of tenant ``tag``: the items never
         execute, their futures are failed with a typed
         ``ht.resilience.RequestCancelled`` (releasing their buffer ownership),
-        and the cancellations land in the lifecycle ledger. In-flight
-        executions are not interrupted (a dispatched XLA call is not safely
-        interruptible); their futures are fulfilled normally. Returns the
-        number of items cancelled."""
-        with self._cv:
-            q = self._queues.pop(tag, None)
+        and the cancellations land in the lifecycle ledger. The tenant's
+        items all live on its affined shard, so one shard lock covers the
+        sweep. In-flight executions are not interrupted (a dispatched XLA
+        call is not safely interruptible); their futures are fulfilled
+        normally. Returns the number of items cancelled."""
+        shard = self._shard_for(tag)
+        with shard._cv:
+            q = shard._queues.pop(tag, None)
             items = list(q) if q else []
             for w in items:
-                self._unindex_locked(w)
-            self._depth -= len(items)
+                shard._unindex_locked(w)
+            shard._depth -= len(items)
             for w in items:
-                self._count_lifecycle_locked("cancelled", w.tenant)
+                shard._count_lifecycle_locked("cancelled", w.tenant)
             if items:
-                self._cv.notify_all()
+                shard._cv.notify_all()
         for w in items:
             self._deliver_lifecycle(
                 w, "cancelled",
@@ -526,44 +864,60 @@ class DispatchScheduler:
         return len(items)
 
     def drain(self, timeout: float = 30.0) -> dict:
-        """Stop admitting, flush the queue, and guarantee every outstanding
+        """Stop admitting, flush every shard, and guarantee every outstanding
         future is fulfilled with a value or a typed error.
 
         Admission closes immediately (``submit`` returns False — submitters
         execute inline or shed, so new work is never dropped) and any test
-        ``pause`` is lifted so the drain thread can run. Then this call waits
-        up to ``timeout`` seconds for the queue to empty and in-flight
-        executions to finish. On success returns ``{"flushed": n, ...}``
-        quietly; on timeout every still-queued item is SHED — its futures are
-        failed with the same typed :class:`~.resilience.DrainTimeout` that is
-        then raised to the caller, naming the undelivered futures — so a
+        ``pause`` is lifted so the drain threads can run. Then this call
+        waits up to ``timeout`` seconds (one shared deadline) for every
+        shard's queue to empty and in-flight executions to finish. On
+        success returns ``{"flushed": True, ...}`` quietly; on timeout every
+        still-queued item ACROSS ALL SHARDS is SHED — each is counted in its
+        own shard's ledger (exactly once) and its futures are failed with
+        the same typed :class:`~.resilience.DrainTimeout` that is then
+        raised to the caller, naming the undelivered futures — so a
         timed-out drain can never leave a ``PendingValue`` blocked forever.
-        Executions still in flight at the timeout are named in the error too;
-        their futures are fulfilled by the executing thread when it finishes.
+        Executions still in flight at the timeout are counted in the error
+        too; their futures are fulfilled by the executing threads when they
+        finish.
 
         The scheduler stays closed to admission afterwards (shutdown is the
         expected caller); use :meth:`reopen` to resume normal service."""
-        with self._cv:
+        with self._gate:
             self._draining = True
             self._drains += 1
             self._paused = False
-            self._cv.notify_all()
-            flushed = self._cv.wait_for(
-                lambda: self._depth == 0 and self._active == 0,
-                timeout=max(0.0, timeout),
-            )
-            leftovers: List[WorkItem] = []
-            still_active = self._active
-            if not flushed:
-                while True:
-                    item = self._pop_one_locked()
-                    if item is None:
-                        break
-                    leftovers.append(item)
-                for w in leftovers:
-                    self._count_lifecycle_locked("shed", w.tenant)
-                if leftovers:
-                    self._cv.notify_all()
+            self._gate.notify_all()
+        deadline = time.monotonic() + max(0.0, timeout)
+        flushed = True
+        leftovers: List[WorkItem] = []
+        still_active = 0
+        for sh in self._shards:
+            with sh._cv:
+                # wake + wait + pop under ONE acquisition per shard: with
+                # timeout=0 the shard loop can never interleave between the
+                # wake-up and the leftover sweep (the single-queue drain's
+                # determinism, preserved per shard)
+                sh._cv.notify_all()
+                ok = sh._cv.wait_for(
+                    lambda: sh._depth == 0 and sh._active == 0,
+                    timeout=max(0.0, deadline - time.monotonic()),
+                )
+                if not ok:
+                    flushed = False
+                    shard_left: List[WorkItem] = []
+                    while True:
+                        item = sh._pop_one_locked()
+                        if item is None:
+                            break
+                        shard_left.append(item)
+                    for w in shard_left:
+                        sh._count_lifecycle_locked("shed", w.tenant)
+                    leftovers.extend(shard_left)
+                    still_active += sh._active
+                    if shard_left:
+                        sh._cv.notify_all()
         if flushed:
             return {"flushed": True, "shed": 0, "in_flight": 0}
         exc = resilience.DrainTimeout(
@@ -586,9 +940,12 @@ class DispatchScheduler:
 
     def reopen(self) -> None:
         """Re-open admission after a :meth:`drain` (tests, rolling restarts)."""
-        with self._cv:
+        with self._gate:
             self._draining = False
-            self._cv.notify_all()
+            self._gate.notify_all()
+        for sh in self._shards:
+            with sh._cv:
+                sh._cv.notify_all()
 
     @contextlib.contextmanager
     def quiesce(self, timeout: float = 30.0, *, tolerate_shed: bool = False):
@@ -616,7 +973,7 @@ class DispatchScheduler:
         (the atexit shutdown drain racing a swap), the scheduler stays
         closed — reopening it would admit work into a shutting-down loop and
         strand its futures at interpreter exit."""
-        with self._cv:
+        with self._gate:
             was_draining = self._draining
             epoch = self._drains
         shed: Optional[BaseException] = None
@@ -630,70 +987,93 @@ class DispatchScheduler:
                 shed = exc
             yield self
         finally:
-            with self._cv:
+            reopened = False
+            with self._gate:
                 if not was_draining and self._drains == epoch + 1:
                     self._draining = False
-                    self._cv.notify_all()
+                    self._gate.notify_all()
+                    reopened = True
+            if reopened:
+                for sh in self._shards:
+                    with sh._cv:
+                        sh._cv.notify_all()
         if shed is not None:
             raise shed
 
     def draining(self) -> bool:
-        with self._cv:
+        with self._gate:
             return self._draining
 
     # ------------------------------------------------------------- telemetry
-    def _note_depth(self, depth: int) -> None:
-        from . import profiler
-
-        if profiler._active:
-            profiler.record_counter("queue_depth", depth)
-
     def stats(self) -> dict:
-        with self._cv:
-            return {
-                "queue_depth": self._depth,
-                "queue_depth_peak": self.queue_depth_peak,
-                "batched_requests": self.batched_requests,
-                "batch_width_hist": dict(self.batch_width_hist),
-                "submitted": self.submitted,
-                "inline_runs": self.inline_runs,
-                "queue_full_events": self.queue_full_events,
-                "drain_rejects": self.drain_rejects,
-                "draining": self._draining,
-                "lifecycle": dict(self.lifecycle),
-                "tenant_lifecycle": {
-                    t: dict(per) for t, per in self.tenant_lifecycle.items()
-                },
-            }
+        """The folded cross-shard telemetry (sums of the per-shard cells; the
+        lifecycle ledger and per-tenant breakdowns merge by key) plus the
+        per-shard breakdown under ``per_shard``.  ``queue_depth_peak`` is
+        the SUM of per-shard peaks — an upper bound on the instantaneous
+        global depth; each shard's own peak is in its ``per_shard`` entry."""
+        per_shard = [sh.snapshot_locked_copy() for sh in self._shards]
+        hist: Dict[int, int] = {}
+        lifecycle = {k: 0 for k in LIFECYCLE_KINDS}
+        tenant_lifecycle: Dict[str, Dict[str, int]] = {}
+        sums = {
+            "queue_depth": 0, "queue_depth_peak": 0, "batched_requests": 0,
+            "submitted": 0, "inline_runs": 0, "queue_full_events": 0,
+            "drain_rejects": 0, "stolen_batch_items": 0,
+            "window_holds": 0, "window_widened": 0, "window_hold_ns": 0,
+        }
+        for snap in per_shard:
+            for k in sums:
+                sums[k] += snap[k]
+            for width, count in snap["batch_width_hist"].items():
+                hist[width] = hist.get(width, 0) + count
+            for k, v in snap["lifecycle"].items():
+                lifecycle[k] += v
+            for tenant, per in snap["tenant_lifecycle"].items():
+                agg = tenant_lifecycle.setdefault(
+                    tenant, {k: 0 for k in LIFECYCLE_KINDS}
+                )
+                for k, v in per.items():
+                    agg[k] += v
+        with self._gate:
+            draining = self._draining
+        out = dict(sums)
+        out["batch_width_hist"] = hist
+        out["lifecycle"] = lifecycle
+        out["tenant_lifecycle"] = tenant_lifecycle
+        out["draining"] = draining
+        out["shards"] = len(self._shards)
+        out["per_shard"] = per_shard
+        return out
 
     def reset_stats(self) -> None:
-        with self._cv:
-            self.queue_depth_peak = self._depth
-            self.batched_requests = 0
-            self.batch_width_hist = {}
-            self.submitted = 0
-            self.inline_runs = 0
-            self.queue_full_events = 0
-            self.drain_rejects = 0
-            self.lifecycle = {k: 0 for k in LIFECYCLE_KINDS}
-            self.tenant_lifecycle = {}
+        for sh in self._shards:
+            sh.reset_stats()
 
     # -------------------------------------------------------------- test hooks
     def pause(self) -> None:
         """Hold queued items (tests build deterministic batches this way).
         Inline fast-path claims are refused while paused, so every submission
         parks in the queue."""
-        with self._cv:
+        with self._gate:
             self._paused = True
 
     def resume(self) -> None:
-        with self._cv:
+        with self._gate:
             self._paused = False
-            self._cv.notify_all()
+            self._gate.notify_all()
+        for sh in self._shards:
+            with sh._cv:
+                sh._cv.notify_all()
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
-        """Block until the queue is empty and nothing is executing."""
-        with self._cv:
-            return self._cv.wait_for(
-                lambda: self._depth == 0 and self._active == 0, timeout=timeout
-            )
+        """Block until every shard's queue is empty and nothing is executing."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        for sh in self._shards:
+            with sh._cv:
+                ok = sh._cv.wait_for(
+                    lambda: sh._depth == 0 and sh._active == 0,
+                    timeout=max(0.0, deadline - time.monotonic()),
+                )
+                if not ok:
+                    return False
+        return True

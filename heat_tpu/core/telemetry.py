@@ -684,8 +684,13 @@ def _hist_from(snap: dict):
 
 #: executor-stat keys that are PEAKS or point-in-time gauges: summing them
 #: across ranks would fabricate a global value no process ever saw (four
-#: ranks peaking at depth 10 did NOT make a depth-40 queue) — they max-fold
-_MAX_FOLD_KEYS = frozenset({"queue_depth_peak", "queue_depth"})
+#: ranks peaking at depth 10 did NOT make a depth-40 queue) — they max-fold.
+#: ``sched_shards`` is a per-process CONFIGURATION value, not a tally: ranks
+#: agree on it in any sane deployment, and max-folding keeps a mixed fleet
+#: readable instead of summing shard counts into nonsense. The ``per_shard``
+#: list is per-process structure — the merge keeps the first shard's copy
+#: (cross-rank per-shard detail lives in the per_process section).
+_MAX_FOLD_KEYS = frozenset({"queue_depth_peak", "queue_depth", "sched_shards"})
 
 
 def _merge_numeric_tree(dst: Dict[str, Any], src: Dict[str, Any]) -> None:

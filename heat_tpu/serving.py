@@ -84,12 +84,45 @@ class ModelPool:
         """Checkpoint directory of the live generation (None before load)."""
         return self._generation
 
-    def load(self, directory: str, **kwargs) -> "ModelPool":
+    def load(self, directory: str, *, warmup: Optional[str] = None,
+             **kwargs) -> "ModelPool":
         """Bind the first generation from a checkpoint (streaming restore +
-        verification). Not a swap: nothing is serving yet, so no drain."""
+        verification). Not a swap: nothing is serving yet, so no drain.
+
+        ``warmup`` points at a persistent compile-cache / warmup-manifest
+        directory (``ht.executor_save_warmup``): the recorded top signatures
+        are replayed into compiled programs NOW — before this pool serves its
+        first request — so a restarted host boots p99-clean (ISSUE 15's
+        cold-start elimination; the coldstart gate measures exactly this)."""
         staged = _checkpoint.load_checkpoint(self._template, directory, **kwargs)
+        if warmup is not None:
+            self.warmup(warmup)
         self._rebind(staged, directory)
         return self
+
+    def warmup(self, path: str) -> dict:
+        """Replay the warmup manifest at ``path`` (``ht.executor_warmup``)
+        and record the outcome in the pool's ledger.  Safe while serving:
+        warmup drives ordinary dispatches, so a live pool just sees a little
+        extra traffic — which is why :func:`swap_state` runs it during
+        STAGING, before the quiesce window ever closes admission."""
+        from .core import _executor
+
+        stats = _executor.executor_warmup(path)
+        entry = {"t": time.time(), "ok": True, "kind": "warmup",
+                 "path": path, **{k: stats[k] for k in
+                                  ("replayed", "aot_loaded", "failed", "skipped")}}
+        with self._lock:
+            self._ledger.append(entry)
+        if diagnostics._enabled:
+            diagnostics.counter("serving.warmup")
+        telemetry.flight_record(
+            "lifecycle", "serving.warmup",
+            f"pool={self.name} replayed={stats['replayed']} "
+            f"aot={stats['aot_loaded']} failed={stats['failed']}",
+            kind="warmup",
+        )
+        return stats
 
     def _rebind(self, state: Any, generation: Optional[str]) -> None:
         self._state = state
@@ -204,6 +237,7 @@ def swap_state(
     *,
     drain_timeout_s: float = 30.0,
     scheduler=None,
+    warmup: Optional[str] = None,
     **load_kwargs,
 ) -> dict:
     """Hot-swap ``pool``'s model state to the generation at ``new_dir`` with
@@ -244,6 +278,13 @@ def swap_state(
 
     try:
         staged = _checkpoint.load_checkpoint(pool._template, new_dir, **load_kwargs)
+        if warmup is not None:
+            # AOT warmup rides the STAGING phase (ISSUE 15): the hot-swapped
+            # host compiles its serving signatures while the OLD generation
+            # keeps serving, so by the time quiesce closes admission and
+            # reopen() follows, the first post-swap request is a replay hit —
+            # never a cold compile inside the drain window
+            pool.warmup(warmup)
     except Exception as exc:
         raise _fail("stage", exc) from exc
 

@@ -1,0 +1,266 @@
+"""Persistent compile cache + AOT warmup tests (ISSUE 15 tentpole (2)).
+
+Covers the cold-start-elimination contract end to end, in-process:
+
+- save → clear → warmup round-trip: ``executor_save_warmup`` records the
+  hottest signatures (specs + serialized executables), and after a full
+  ``clear_executor_cache`` — the in-process stand-in for a fresh boot —
+  ``executor_warmup`` rebuilds every one of them through the REAL dispatch
+  layer, so the first post-warmup traffic is pure replay hits with zero
+  retraces and the fused/staged values stay bit-identical;
+- artifact loads: with ``HEAT_TPU_EXEC_CACHE`` armed, a program's first call
+  deserializes its cached executable instead of trace+compile;
+- corruption tolerance: a truncated blob and a corrupt index are TYPED
+  rejections (``cache-corrupt`` on the always-on resilience event stream) —
+  the executor recompiles and values stay correct, the CI cache-poisoning
+  step's contract;
+- manifest ordering: (hits desc, label asc) — the satellite's deterministic
+  top-K — and the ``top`` cap;
+- ``ModelPool.warmup`` ledger wiring.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.core import _compile_cache, _executor, diagnostics, resilience
+from heat_tpu.testing import TestCase
+
+_OLD_THRESHOLD = None
+
+
+def setUpModule():
+    # compile-on-first-miss: warmup specs are recorded at compile time
+    global _OLD_THRESHOLD
+    _OLD_THRESHOLD = os.environ.get("HEAT_TPU_JIT_THRESHOLD")
+    os.environ["HEAT_TPU_JIT_THRESHOLD"] = "1"
+    _executor.reload_env_knobs()
+
+
+def tearDownModule():
+    if _OLD_THRESHOLD is None:
+        os.environ.pop("HEAT_TPU_JIT_THRESHOLD", None)
+    else:
+        os.environ["HEAT_TPU_JIT_THRESHOLD"] = _OLD_THRESHOLD
+    _executor.reload_env_knobs()
+
+
+def _resilience_events():
+    with diagnostics._lock:
+        return list(diagnostics._resilience_events)
+
+
+class _CacheCase(TestCase):
+    def setUp(self):
+        super().setUp()
+        _executor.clear_executor_cache()
+        self.dir = tempfile.mkdtemp(prefix="ht-compile-cache-")
+        self.addCleanup(shutil.rmtree, self.dir, ignore_errors=True)
+
+    def _arm(self, path):
+        old = os.environ.get("HEAT_TPU_EXEC_CACHE")
+
+        def restore():
+            if old is None:
+                os.environ.pop("HEAT_TPU_EXEC_CACHE", None)
+            else:
+                os.environ["HEAT_TPU_EXEC_CACHE"] = old
+            _executor.reload_env_knobs()
+
+        os.environ["HEAT_TPU_EXEC_CACHE"] = path
+        _executor.reload_env_knobs()
+        self.addCleanup(restore)
+
+    def _traffic(self):
+        """The workload whose signatures get recorded/warmed: a fused
+        fan-out chain (defer family, interior output) plus staged r/c ops.
+        Returns the reference bytes for bit-parity checks."""
+        np_a = np.arange(12.0, dtype=np.float32)
+        a = ht.array(np_a, split=0)
+        b = ht.array(np_a + 1.0, split=0)
+        t = a + b
+        u = t * 2.0
+        v = t * 3.0
+        ref = {
+            "u": u.numpy().tobytes(),
+            "v": v.numpy().tobytes(),
+            "t": t.numpy().tobytes(),
+            "sum": ht.sum(a).numpy().tobytes(),
+            "cum": ht.cumsum(a, axis=0).numpy().tobytes(),
+        }
+        return np_a, ref
+
+
+class TestFingerprint(_CacheCase):
+    def test_fingerprint_is_canonical(self):
+        s1 = {"family": "l", "op": "sin", "gshape": [8], "split": 0}
+        s2 = {"split": 0, "gshape": [8], "op": "sin", "family": "l"}
+        self.assertEqual(_compile_cache.fingerprint(s1),
+                         _compile_cache.fingerprint(s2))
+        s3 = dict(s1, gshape=[9])
+        self.assertNotEqual(_compile_cache.fingerprint(s1),
+                            _compile_cache.fingerprint(s3))
+
+    def test_specs_recorded_at_compile(self):
+        self._traffic()
+        with _executor._lock:
+            specs = [
+                e.spec for e in _executor._programs.values()
+                if e is not _executor.UNSUPPORTED
+            ]
+        families = {s["family"] for s in specs if s is not None}
+        self.assertIn("defer", families)
+        self.assertIn("r", families)
+        self.assertIn("c", families)
+
+
+class TestSaveWarmupRoundTrip(_CacheCase):
+    def test_save_then_warmup_rebuilds_every_signature(self):
+        np_a, ref = self._traffic()
+        res = _executor.executor_save_warmup(self.dir, top=16)
+        self.assertGreaterEqual(res["saved"], 4)
+        index = json.load(open(os.path.join(self.dir, "index.json")))
+        self.assertEqual(index["schema"], _compile_cache.SCHEMA)
+        self.assertEqual(len(index["entries"]), res["saved"])
+
+        # "fresh boot": drop every program, then warm up from the manifest
+        self._arm(self.dir)
+        _executor.clear_executor_cache()
+        stats = _executor.executor_warmup(self.dir)
+        self.assertEqual(stats["failed"], 0, stats)
+        self.assertGreaterEqual(stats["replayed"], 4)
+
+        # first traffic after warmup: pure replay — no misses, no retraces,
+        # bit-identical values (cold start eliminated)
+        ht.reset_executor_stats()
+        a = ht.array(np_a, split=0)
+        b = ht.array(np_a + 1.0, split=0)
+        t = a + b
+        u = t * 2.0
+        v = t * 3.0
+        self.assertEqual(u.numpy().tobytes(), ref["u"])
+        self.assertEqual(v.numpy().tobytes(), ref["v"])
+        self.assertEqual(t.numpy().tobytes(), ref["t"])
+        self.assertEqual(ht.sum(a).numpy().tobytes(), ref["sum"])
+        self.assertEqual(ht.cumsum(a, axis=0).numpy().tobytes(), ref["cum"])
+        st = ht.executor_stats()
+        self.assertEqual(st["misses"], 0, "warm traffic must be pure hits")
+        self.assertEqual(st["retraces"], 0)
+
+    def test_artifacts_load_instead_of_compiling(self):
+        self._traffic()
+        res = _executor.executor_save_warmup(self.dir, top=16)
+        self.assertGreaterEqual(res["artifacts"], 1,
+                                "backend supports serialization: artifacts "
+                                "must be produced")
+        self._arm(self.dir)
+        _executor.clear_executor_cache()
+        stats = _executor.executor_warmup(self.dir)
+        self.assertGreaterEqual(stats["aot_loaded"], 1, stats)
+        self.assertEqual(stats["failed"], 0)
+
+    def test_warmup_without_cache_dir_or_manifest(self):
+        with self.assertRaises(ValueError):
+            _executor.executor_warmup(None)
+        stats = _executor.executor_warmup(self.dir)  # empty dir: no manifest
+        self.assertEqual(stats["replayed"], 0)
+
+
+class TestCorruptionTolerance(_CacheCase):
+    def _poison_one_blob(self):
+        blobs = os.listdir(os.path.join(self.dir, "blobs"))
+        self.assertTrue(blobs)
+        path = os.path.join(self.dir, "blobs", blobs[0])
+        data = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(data[: max(1, len(data) // 2)])  # truncate mid-file
+        return path
+
+    def test_truncated_blob_is_typed_rejection_then_recompile(self):
+        np_a, ref = self._traffic()
+        _executor.executor_save_warmup(self.dir, top=16)
+        self._poison_one_blob()
+        self._arm(self.dir)
+        _executor.clear_executor_cache()
+        before = len([e for e in _resilience_events()
+                      if e["kind"] == "cache-corrupt"])
+        stats = _executor.executor_warmup(self.dir)
+        self.assertEqual(stats["failed"], 0,
+                         "a corrupt artifact must recompile, not fail")
+        rejects = [e for e in _resilience_events()
+                   if e["kind"] == "cache-corrupt"][before:]
+        self.assertTrue(rejects, "corruption must be a TYPED rejection on "
+                        "the always-on resilience stream")
+        self.assertIn("executor.compile_cache", rejects[0]["site"])
+        # traffic is still bit-correct on the recompiled program
+        a = ht.array(np_a, split=0)
+        self.assertEqual(ht.sum(a).numpy().tobytes(), ref["sum"])
+
+    def test_corrupt_index_is_typed_rejection_and_serving_continues(self):
+        np_a, ref = self._traffic()
+        _executor.executor_save_warmup(self.dir, top=16)
+        with open(os.path.join(self.dir, "index.json"), "w") as f:
+            f.write('{"schema": "heat-tpu-compile-cache/1", "entries": {tr')
+        self._arm(self.dir)
+        _executor.clear_executor_cache()
+        before = len([e for e in _resilience_events()
+                      if e["kind"] == "cache-corrupt"])
+        stats = _executor.executor_warmup(self.dir)
+        self.assertEqual(stats["replayed"], 0)
+        self.assertGreater(
+            len([e for e in _resilience_events()
+                 if e["kind"] == "cache-corrupt"]), before)
+        # cold but correct: dispatch recompiles as if no cache existed
+        a = ht.array(np_a, split=0)
+        self.assertEqual(ht.sum(a).numpy().tobytes(), ref["sum"])
+
+    def test_save_over_corrupt_index_rewrites_cleanly(self):
+        self._traffic()
+        os.makedirs(self.dir, exist_ok=True)
+        with open(os.path.join(self.dir, "index.json"), "w") as f:
+            f.write("not json")
+        res = _executor.executor_save_warmup(self.dir, top=8)
+        self.assertGreaterEqual(res["saved"], 1)
+        index = json.load(open(os.path.join(self.dir, "index.json")))
+        self.assertEqual(index["schema"], _compile_cache.SCHEMA)
+
+
+class TestManifestOrdering(_CacheCase):
+    def test_top_k_in_hits_desc_label_asc_order(self):
+        np_a, _ = self._traffic()
+        a = ht.array(np_a, split=0)
+        for _ in range(3):  # make r:sum the hottest signature
+            ht.sum(a).numpy()
+        _executor.executor_save_warmup(self.dir, top=2)
+        index = json.load(open(os.path.join(self.dir, "index.json")))
+        self.assertEqual(len(index["entries"]), 2)
+        entries = sorted(
+            index["entries"].values(),
+            key=lambda e: (-e["hits"], e["label"]),
+        )
+        self.assertEqual(entries[0]["label"], "r:sum")
+        # equal-hit entries tie-break on label ascending — mirrored by
+        # executor_stats(top=N) (the satellite fix)
+        labels = [e["label"] for e in entries]
+        hits = [e["hits"] for e in entries]
+        for i in range(1, len(entries)):
+            if hits[i] == hits[i - 1]:
+                self.assertLess(labels[i - 1], labels[i])
+
+
+class TestPoolWarmupWiring(_CacheCase):
+    def test_pool_warmup_records_ledger_entry(self):
+        self._traffic()
+        _executor.executor_save_warmup(self.dir, top=8)
+        _executor.clear_executor_cache()
+        pool = ht.serving.ModelPool(template=None, name="warm-pool")
+        stats = pool.warmup(self.dir)
+        self.assertGreaterEqual(stats["replayed"], 1)
+        entries = [e for e in pool.swap_ledger() if e.get("kind") == "warmup"]
+        self.assertEqual(len(entries), 1)
+        self.assertEqual(entries[0]["replayed"], stats["replayed"])
+        self.assertTrue(entries[0]["ok"])

@@ -92,17 +92,17 @@ class TestSwap(TestCase):
         sched = _executor._get_scheduler()
         sched.pause()  # hold queued work so the drain cannot flush... except
         # drain() lifts pause; park a fake in-flight execution instead
-        with sched._cv:
-            sched._active += 1
+        with sched._shards[0]._cv:
+            sched._shards[0]._active += 1
         try:
             with self.assertRaises(resilience.SwapFailed) as ctx:
                 ht.serving.swap_state(
                     self.pool, self.gen["b"], drain_timeout_s=0.2
                 )
         finally:
-            with sched._cv:
-                sched._active -= 1
-                sched._cv.notify_all()
+            with sched._shards[0]._cv:
+                sched._shards[0]._active -= 1
+                sched._shards[0]._cv.notify_all()
         self.assertEqual(ctx.exception.stage, "drain")
         self.assertEqual(self.pool.generation, self.gen["a"])
         self.assertFalse(sched.draining(), "quiesce must reopen after timeout")
@@ -142,30 +142,30 @@ class TestSwap(TestCase):
         window — the peer-failover sentinel clear depends on it — and the
         DrainTimeout re-raises on exit for the caller's accounting."""
         sched = _executor._get_scheduler()
-        with sched._cv:
-            sched._active += 1  # park a fake in-flight execution
+        with sched._shards[0]._cv:
+            sched._shards[0]._active += 1  # park a fake in-flight execution
         ran = []
         try:
             with self.assertRaises(resilience.DrainTimeout):
                 with sched.quiesce(0.2, tolerate_shed=True):
                     ran.append(sched.draining())
         finally:
-            with sched._cv:
-                sched._active -= 1
-                sched._cv.notify_all()
+            with sched._shards[0]._cv:
+                sched._shards[0]._active -= 1
+                sched._shards[0]._cv.notify_all()
         self.assertEqual(ran, [True], "body must run while still closed")
         self.assertFalse(sched.draining(), "quiesce must reopen after exit")
         # default behaviour unchanged: the body is skipped on a timeout
-        with sched._cv:
-            sched._active += 1
+        with sched._shards[0]._cv:
+            sched._shards[0]._active += 1
         try:
             with self.assertRaises(resilience.DrainTimeout):
                 with sched.quiesce(0.2):
                     self.fail("body must not run on an intolerant timeout")
         finally:
-            with sched._cv:
-                sched._active -= 1
-                sched._cv.notify_all()
+            with sched._shards[0]._cv:
+                sched._shards[0]._active -= 1
+                sched._shards[0]._cv.notify_all()
         self.assertFalse(sched.draining())
 
     def test_on_peer_failure_drain_timeout_clears_sentinel_before_reopen(self):
@@ -186,8 +186,8 @@ class TestSwap(TestCase):
                 observed.append(("reset", orig_reopen_check()))
                 _real()
 
-            with sched._cv:
-                sched._active += 1  # the drain cannot flush: DrainTimeout
+            with sched._shards[0]._cv:
+                sched._shards[0]._active += 1  # the drain cannot flush: DrainTimeout
             real_reset = supervision.reset_abort
             supervision.reset_abort = spying_reset
             try:
@@ -197,9 +197,9 @@ class TestSwap(TestCase):
                 )
             finally:
                 supervision.reset_abort = real_reset
-                with sched._cv:
-                    sched._active -= 1
-                    sched._cv.notify_all()
+                with sched._shards[0]._cv:
+                    sched._shards[0]._active -= 1
+                    sched._shards[0]._cv.notify_all()
             self.assertEqual(observed, [("reset", True)],
                              "sentinel must clear while still draining")
             self.assertTrue(entry["ok"])
