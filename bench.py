@@ -474,6 +474,32 @@ def _bench_serving(devices: int = 8, timeout_s: float = 900.0) -> list:
         import traceback
 
         traceback.print_exc(file=sys.stderr)
+    # result-cache comparison (ISSUE 17): Zipf-replay open-loop p99,
+    # HEAT_TPU_RESULT_CACHE=1 vs recompute at the identical offered rate —
+    # the cache-arm/recompute-arm records and the must-beat ratio ride
+    # extra_metrics so the memoization tier's measured win (and its
+    # hit/invalidation tallies) land in the round's JSON even relay-down.
+    # Isolated like the async gate: a failed comparison costs no records.
+    cache_script = os.path.join(os.path.dirname(script), "cache_gate.py")
+    try:
+        proc = subprocess.run(
+            [sys.executable, cache_script, "--devices", str(devices),
+             "--smoke"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        for line in proc.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                records.append(rec)
+    except Exception:
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
     return records
 
 

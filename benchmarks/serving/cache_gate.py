@@ -1,0 +1,413 @@
+"""Result-cache gate: Zipf traffic replay, cache-on vs recompute, with a
+mid-run ``swap_state`` invalidation that must provably never serve stale.
+
+Runs ONE value-checkable serving workload (a :class:`ht.serving.ModelPool`
+weight against a pool of pre-staged, generation-registered input batches —
+the request shape the cross-request result cache memoizes) through two arms
+in one virtual mesh, both replaying the IDENTICAL Zipf identity sequence and
+burst-laced open-loop arrival schedule (``harness._zipf_identities`` /
+``harness._zipf_replay``) at the IDENTICAL offered rate:
+
+1. ``HEAT_TPU_RESULT_CACHE=0`` — every request recomputes (the baseline arm;
+   its measured capacity pins the offered rate for both).
+2. ``HEAT_TPU_RESULT_CACHE=1`` — hot identities are served from the
+   memoization tier.
+
+Both arms hot-swap the pool to generation B mid-run (``swap_state`` under
+live load), so the cache arm's entries keyed on generation A are invalidated
+while traffic flows. Gate (``--check``), evaluated by :func:`evaluate` —
+pure record math, tests drive it with canned records:
+
+- **p99 must beat recompute**: cache-arm open-loop p99 <= recompute-arm p99
+  at the identical offered rate (ratio <= ``P99_MAX_RATIO``).
+- **staleness is zero, provably**: every request STARTING after the swap
+  returns generation B's value; one generation-A value after the boundary is
+  a served stale entry and a red gate. Values matching neither generation
+  (torn) are equally fatal. Checked on BOTH arms.
+- **accounting is exact on both arms**: ``admitted + shed + failed ==
+  offered``, with ``failed`` (untyped errors) zero.
+- **the cache worked**: the cache arm records hits > 0 and swap-driven
+  invalidations > 0 (a gate that "wins" with a dead cache measures nothing).
+- **poisoned entry rejects typed**: after the drive, one cached entry is
+  corrupted in place (``_result_cache._poison_one``); the next request must
+  recompute the CORRECT value, count a reject, and leave a ``cache-corrupt``
+  resilience event at ``executor.result_cache`` — never serve the poison.
+
+A failing ``--check`` run retries once with fresh arms (the overload/swap
+gate stance: only failing BOTH fresh runs is red — a p99 over a few hundred
+samples is nearly the max sample on a noisy shared box).
+
+Standalone::
+
+    python benchmarks/serving/cache_gate.py --devices 8 --smoke --check
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+from benchmarks.serving.harness import (  # noqa: E402
+    _bootstrap, _percentile_ms, _zipf_identities, _zipf_replay,
+)
+from benchmarks.serving import workloads  # noqa: E402
+
+N = 8192
+SCALE_A, SCALE_B = 1.0, 3.0
+N_IDENTITIES = 12   # staged-batch slots the Zipf sequence draws from
+ZIPF_ALPHA = 1.1
+P99_MAX_RATIO = 1.0  # the cache arm must BEAT recompute, not tie-with-margin
+
+
+def _build(tmpdir):
+    """The value-checkable workload: ``request(slot)`` computes
+    ``x_slot * w + w`` (one fused force over two REGISTERED leaves — the
+    cacheable shape) and returns element 0, which identifies both the slot
+    and the serving generation exactly: ``scale * (slot + 2)``."""
+    import numpy as np
+
+    import jax
+
+    import heat_tpu as ht
+
+    gens = {}
+    for name, scale in (("A", SCALE_A), ("B", SCALE_B)):
+        w = ht.array(np.full(N, scale, np.float32), split=0)
+        gens[name] = os.path.join(tmpdir, f"gen{name}")
+        ht.save_checkpoint({"w": w}, gens[name])
+    pool = ht.serving.ModelPool(
+        {"w": ht.zeros((N,), split=0)}, name="cache-gate"
+    ).load(gens["A"])
+    batches = [
+        workloads._register(workloads.StagedBatch(
+            value=ht.array(np.full(N, float(s + 1), np.float32), split=0),
+            tag=f"cachegate:x:{s}",
+            gen=next(workloads._GEN_COUNTER),
+        ))
+        for s in range(N_IDENTITIES)
+    ]
+
+    def request(slot: int) -> float:
+        w = pool.state["w"]
+        y = batches[slot].value * w + w
+        arr = y.parray
+        jax.block_until_ready(arr)
+        return float(np.asarray(arr)[0])
+
+    def expect(slot: int, scale: float) -> float:
+        return scale * (slot + 2)
+
+    return pool, gens, batches, request, expect
+
+
+def _drive(pool, gens, request, expect, offered_rps, n_requests, concurrency,
+           seed):
+    """One arm: open-loop Zipf replay with a swap to generation B once a
+    third of the requests completed. Returns the raw arm record. The
+    staleness boundary is the instant ``swap_state`` RETURNS — every request
+    starting after it must observe B."""
+    import heat_tpu as ht
+    from heat_tpu.core import profiler, resilience
+
+    slots = _zipf_identities(n_requests, N_IDENTITIES, ZIPF_ALPHA, seed)
+    arrivals = _zipf_replay(n_requests, offered_rps, seed)
+    outcomes = [None] * n_requests  # (status, value, t_start, slot)
+    start = time.perf_counter()
+    swap_done = {}
+    counter = [0]
+    lock = threading.Lock()
+
+    def _completed() -> int:
+        return sum(1 for o in outcomes if o is not None)  # relaxed snapshot
+
+    def swapper():
+        # completion-anchored boundary, like the swap gate: both sides of the
+        # swap always carry accounted, value-checked requests
+        while _completed() < n_requests // 3:
+            time.sleep(0.002)
+        ht.serving.swap_state(pool, gens["B"], drain_timeout_s=30.0)
+        swap_done["t"] = time.perf_counter() - start
+
+    def worker():
+        while True:
+            with lock:
+                i = counter[0]
+                counter[0] += 1
+            if i >= n_requests:
+                return
+            sched_t = start + arrivals[i]
+            now = time.perf_counter()
+            if now < sched_t:
+                time.sleep(sched_t - now)
+            t0 = time.perf_counter()
+            try:
+                with profiler.request(f"cachegate.{slots[i] % 4}"):
+                    value = request(slots[i])
+                outcomes[i] = ("ok", value, t0 - start, slots[i],
+                               time.perf_counter() - t0)
+            except (resilience.Shed, resilience.DeadlineExceeded,
+                    resilience.RequestCancelled, resilience.DrainTimeout):
+                outcomes[i] = ("shed", None, t0 - start, slots[i], 0.0)
+            except Exception as exc:  # untyped — the gate fails on any
+                outcomes[i] = ("failed", repr(exc), t0 - start, slots[i], 0.0)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    swap_thread = threading.Thread(target=swapper, daemon=True)
+    for t in threads:
+        t.start()
+    swap_thread.start()
+    for t in threads:
+        t.join()
+    swap_thread.join(timeout=120)
+    return _score(outcomes, swap_done.get("t"), expect)
+
+
+def _score(outcomes, boundary, expect):
+    admitted = shed = failed = 0
+    stale_after_swap = torn = post_swap_ok = 0
+    lats = []
+    untyped = []
+    for status, value, t_start, slot, lat in outcomes:
+        if status == "shed":
+            shed += 1
+            continue
+        if status == "failed":
+            failed += 1
+            untyped.append(value)
+            continue
+        admitted += 1
+        lats.append(lat)
+        is_a = abs(value - expect(slot, SCALE_A)) < 1e-3
+        is_b = abs(value - expect(slot, SCALE_B)) < 1e-3
+        if not (is_a or is_b):
+            torn += 1
+        elif boundary is not None and t_start > boundary:
+            post_swap_ok += 1
+            if is_a:
+                stale_after_swap += 1  # a generation-A value served POST-swap
+    rec = {
+        "offered": len(outcomes),
+        "admitted": admitted,
+        "shed": shed,
+        "failed": failed,
+        "accounted": admitted + shed + failed == len(outcomes),
+        "swapped": boundary is not None,
+        "post_swap_requests": post_swap_ok,
+        "stale_after_swap": stale_after_swap,
+        "torn_values": torn,
+        "untyped_failures": untyped[:4],
+    }
+    if lats:
+        rec["p50_ms"] = round(_percentile_ms(lats, 0.50), 3)
+        rec["p99_ms"] = round(_percentile_ms(lats, 0.99), 3)
+    return rec
+
+
+def _poison_leg(request, emit):
+    """Corrupt the hottest cached entry in place; the next request must
+    recompute the correct value through a typed ``cache-corrupt`` rejection,
+    never serve the poison."""
+    from heat_tpu.core import _result_cache, diagnostics
+
+    import heat_tpu as ht
+
+    clean = request(0)
+    before = ht.executor_stats()["result_cache"]["rejects"]
+    ev_before = sum(
+        1 for e in diagnostics.report()["resilience_events"]
+        if e.get("kind") == "cache-corrupt"
+    )
+    poisoned = _result_cache._poison_one()
+    value = request(0)
+    after = ht.executor_stats()["result_cache"]["rejects"]
+    ev_after = sum(
+        1 for e in diagnostics.report()["resilience_events"]
+        if e.get("kind") == "cache-corrupt"
+    )
+    rec = {
+        "poisoned_entries": poisoned,
+        "value_correct": abs(value - clean) < 1e-3,
+        "rejects_delta": after - before,
+        "corrupt_events_delta": ev_after - ev_before,
+    }
+    emit(json.dumps({"cache_gate_poison_leg": rec}))
+    return rec
+
+
+def run_cache_gate(smoke=True, requests=None, concurrency=4, seed=23,
+                   emit=print):
+    """Run both arms and the poison leg; returns the comparison record."""
+    import tempfile
+
+    import jax
+
+    import heat_tpu as ht
+    from heat_tpu.core import _executor, profiler
+
+    ndev = len(jax.devices())
+    n_requests = requests or (192 if smoke else 512)
+    was_active = profiler.active()
+    profiler.enable()
+    old = os.environ.get("HEAT_TPU_RESULT_CACHE")
+    tmpdir = tempfile.mkdtemp(prefix="heat-tpu-cache-gate-")
+    record = {"metric": "serving_cache_gate", "unit": "ratio",
+              "devices": ndev, "concurrency": concurrency,
+              "requests": n_requests, "zipf_alpha": ZIPF_ALPHA,
+              "identities": N_IDENTITIES}
+    try:
+        # ---- arm 1: recompute -------------------------------------------
+        os.environ["HEAT_TPU_RESULT_CACHE"] = "0"
+        _executor.reload_env_knobs()
+        pool, gens, batches, request, expect = _build(tmpdir)
+        for s in range(N_IDENTITIES):
+            request(s)  # compile paths, uncounted
+        t0 = time.perf_counter()
+        n_cap = 24
+        for i in range(n_cap):
+            request(i % N_IDENTITIES)
+        capacity = n_cap / (time.perf_counter() - t0)
+        # push the recompute arm into its queueing regime: the cache's win is
+        # the drained queue, and the bursts in the replay schedule need a
+        # near-capacity base rate to pile up behind a miss
+        offered = max(2.0, 0.85 * capacity * concurrency)
+        record["offered_rps"] = round(offered, 2)
+        emit(json.dumps({"info": "cache gate arm 1/2: recompute "
+                         f"(offered {offered:.1f} rps)"}))
+        arm_off = _drive(pool, gens, request, expect, offered, n_requests,
+                         concurrency, seed)
+        record["recompute"] = arm_off
+
+        # ---- arm 2: result cache, identical replay ----------------------
+        os.environ["HEAT_TPU_RESULT_CACHE"] = "1"
+        _executor.reload_env_knobs()
+        # fresh pool + staged batches: the cache arm replays the same
+        # identity sequence against its OWN generations (fresh gen table)
+        pool, gens, batches, request, expect = _build(tmpdir)
+        for s in range(N_IDENTITIES):
+            request(s)  # prime: every identity cached at generation A
+        ht.reset_executor_stats()
+        emit(json.dumps({"info": "cache gate arm 2/2: result cache on, "
+                         "identical replay"}))
+        arm_on = _drive(pool, gens, request, expect, offered, n_requests,
+                        concurrency, seed)
+        cache_stats = ht.executor_stats()["result_cache"]
+        arm_on["cache"] = {
+            k: cache_stats[k]
+            for k in ("hits", "misses", "stores", "bytes_saved",
+                      "invalidations", "replications", "rejects")
+        }
+        record["cached"] = arm_on
+        record["poison"] = _poison_leg(request, emit)
+        if arm_off.get("p99_ms") and arm_on.get("p99_ms"):
+            record["value"] = round(
+                arm_on["p99_ms"] / max(arm_off["p99_ms"], 1e-9), 4
+            )
+        emit(json.dumps(record))
+        return record
+    finally:
+        if old is None:
+            os.environ.pop("HEAT_TPU_RESULT_CACHE", None)
+        else:
+            os.environ["HEAT_TPU_RESULT_CACHE"] = old
+        _executor.reload_env_knobs()
+        if not was_active:
+            profiler.disable()
+        _executor._get_scheduler().reopen()
+
+
+def evaluate(rec, emit=print) -> bool:
+    """Gate one comparison record. Returns ``failed``. Pure record math."""
+    failed = False
+
+    def err(msg):
+        nonlocal failed
+        failed = True
+        emit(json.dumps({"error": msg}))
+
+    for arm in ("recompute", "cached"):
+        a = rec.get(arm)
+        if a is None:
+            err(f"cache gate: {arm} arm missing")
+            continue
+        if not a["accounted"]:
+            err(f"{arm} arm accounting broken: admitted {a['admitted']} + "
+                f"shed {a['shed']} + failed {a['failed']} != offered "
+                f"{a['offered']}")
+        if a["failed"]:
+            err(f"{arm} arm: {a['failed']} request(s) died UNTYPED: "
+                f"{a['untyped_failures']}")
+        if not a["swapped"]:
+            err(f"{arm} arm: the mid-run swap never committed")
+        elif a["post_swap_requests"] <= 0:
+            err(f"{arm} arm: no request started after the swap — the "
+                "invalidation boundary was not exercised")
+        if a["stale_after_swap"]:
+            err(f"{arm} arm: {a['stale_after_swap']} request(s) starting "
+                "AFTER the swap returned generation A — a stale entry was "
+                "served")
+        if a["torn_values"]:
+            err(f"{arm} arm: {a['torn_values']} request(s) matched NEITHER "
+                "generation")
+    cache = rec.get("cached", {}).get("cache")
+    if cache is not None:
+        if cache["hits"] <= 0:
+            err("cache arm recorded ZERO hits — the tier never served; the "
+                "p99 comparison measures nothing")
+        if cache["invalidations"] <= 0:
+            err("cache arm recorded ZERO invalidations — the mid-run swap "
+                "did not sweep the generation-A entries")
+    poison = rec.get("poison")
+    if poison is not None:
+        if poison["poisoned_entries"] <= 0:
+            err("poison leg found no cached entry to corrupt")
+        elif not poison["value_correct"]:
+            err("poison leg: the post-poison request returned a WRONG value "
+                "— the corrupt entry was served")
+        elif poison["rejects_delta"] <= 0 or poison["corrupt_events_delta"] <= 0:
+            err("poison leg: the corrupt entry was dropped without the typed "
+                "cache-corrupt rejection (rejects "
+                f"{poison['rejects_delta']}, events "
+                f"{poison['corrupt_events_delta']})")
+    ratio = rec.get("value")
+    if ratio is None:
+        err("cache gate: no p99 ratio (an arm produced no latencies)")
+    elif ratio > P99_MAX_RATIO:
+        err(f"cache-arm open-loop p99 ratio {ratio} > {P99_MAX_RATIO}: the "
+            "result cache must beat recompute at the identical offered rate")
+    return failed
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--requests", type=int, default=None)
+    parser.add_argument("--concurrency", type=int, default=4)
+    parser.add_argument("--check", action="store_true",
+                        help="exit non-zero when the cache arm fails the "
+                        "must-beat / never-stale / typed-rejection gates")
+    args = parser.parse_args(argv)
+    _bootstrap(args.devices)
+    rec = run_cache_gate(smoke=args.smoke, requests=args.requests,
+                         concurrency=args.concurrency)
+    failed = evaluate(rec)
+    if failed and args.check:
+        # one retry, fresh arms and a fresh seed: only failing BOTH fresh
+        # comparisons is a real regression (the swap/overload gate stance)
+        print(json.dumps({"info": "cache gate failed once; retrying to rule "
+                          "out a single-run outlier"}))
+        rec = run_cache_gate(smoke=args.smoke, requests=args.requests,
+                             concurrency=args.concurrency, seed=29)
+        failed = evaluate(rec)
+    if args.check and failed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -88,6 +88,8 @@ def _bootstrap(devices: int) -> None:
         "HEAT_TPU_BATCH_WINDOW_US",
         "HEAT_TPU_EXEC_CACHE",
         "HEAT_TPU_COMPILE_CACHE",
+        "HEAT_TPU_RESULT_CACHE",
+        "HEAT_TPU_RESULT_CACHE_BYTES",
     ):
         env.pop(knob, None)
     flags = [
@@ -167,6 +169,52 @@ def _poisson_arrivals(n_requests: int, rate_rps: float, seed: int = 0):
         t += rng.expovariate(rate_rps)
         arrivals.append(t)
     return arrivals
+
+
+def _zipf_identities(n_requests: int, n_identities: int, alpha: float = 1.1,
+                     seed: int = 0):
+    """Zipf-distributed request identities: request ``i`` re-issues staged
+    input slot ``out[i]`` (0..n_identities-1), with rank ``r`` weighted
+    ``1/r**alpha`` — the production traffic shape where a few hot inputs
+    dominate (exactly what a cross-request result cache exploits) while the
+    tail keeps forcing real recomputes.  Deterministic per seed so the cache
+    arm and the recompute arm of a gate replay the IDENTICAL identity
+    sequence."""
+    weights = [1.0 / (r ** alpha) for r in range(1, n_identities + 1)]
+    rng = random.Random(seed)
+    # shuffle rank->slot so the hot slot isn't always slot 0 across seeds
+    slots = list(range(n_identities))
+    rng.shuffle(slots)
+    return [slots[rng.choices(range(n_identities), weights)[0]]
+            for _ in range(n_requests)]
+
+
+def _zipf_replay(n_requests: int, rate_rps: float, seed: int = 0,
+                 burst_every: int = 16, burst_len: int = 4):
+    """Arrival schedule for the Zipf traffic-replay gate: a Poisson base
+    process at ``rate_rps`` with a short near-simultaneous burst injected
+    every ``burst_every`` requests (``burst_len`` arrivals squeezed into the
+    same instant) — the replayed-traffic shape where cached hot entries pay
+    off hardest and queueing under miss storms is visible.  Monotonic
+    non-decreasing offsets, deterministic per seed; mean offered rate stays
+    ``rate_rps`` because burst arrivals borrow their gaps from the base
+    process rather than adding requests."""
+    rng = random.Random(seed)
+    arrivals, t = [], 0.0
+    i = 0
+    while i < n_requests:
+        if burst_every and i and i % burst_every == 0:
+            # the burst's arrivals land together at the END of the window the
+            # base process would have spread them over, keeping the mean rate
+            burst = min(burst_len, n_requests - i)
+            t += sum(rng.expovariate(rate_rps) for _ in range(burst))
+            arrivals.extend([t] * burst)
+            i += burst
+        else:
+            t += rng.expovariate(rate_rps)
+            arrivals.append(t)
+            i += 1
+    return arrivals[:n_requests]
 
 
 def _record(name: str, mode: str, latencies, wall: float, ndev: int,

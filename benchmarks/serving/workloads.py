@@ -9,6 +9,16 @@ issue from many threads at once; each request rotates through a small pool of
 pre-staged input batches so the signature cache is exercised as replay (the
 serving steady state), not as compile.
 
+Every pre-staged batch carries a FIRST-CLASS generation id
+(:class:`StagedBatch` — a monotonically increasing integer, one per staged
+buffer, registered with the result cache's generation table).  The id is
+what the cross-request result cache keys these buffers on (no device
+readback — ``_result_cache.register_generation``), and what the cache gate
+and the invalidation tests assert against: rotation order used to be the
+*implicit* identity of a batch; the explicit ``gen`` field makes staleness
+checkable.  Re-staging a slot through :func:`restage` bumps the id, so every
+memoised result keyed on the old buffer fails validation closed.
+
 The four shapes cover the domain modules the ROADMAP names:
 
 - ``kmeans_assign``  — streaming KMeans assignment: nearest-centroid labels
@@ -28,24 +38,66 @@ the on-chip shape.
 
 from __future__ import annotations
 
-from typing import Callable, List, NamedTuple
+import itertools
+from typing import Any, Callable, List, NamedTuple
 
 N_BATCH_POOL = 8  # pre-staged input batches each request rotates through
+
+# one process-wide monotonic source for staged-batch generation ids: ids are
+# unique across workloads AND across re-stagings, never recycled
+_GEN_COUNTER = itertools.count(1)
+
+
+class StagedBatch(NamedTuple):
+    """One pre-staged input batch with its explicit generation identity."""
+
+    value: Any   # the staged DNDarray (or raw jax array) requests read
+    tag: str     # stable slot tag, e.g. "wl:kmeans_assign:3"
+    gen: int     # monotonically increasing generation id for this slot
 
 
 class Workload(NamedTuple):
     name: str
     fn: Callable[[int], None]  # run request i, synchronously
+    batches: List[StagedBatch] = []  # the rotating pre-staged input pool
 
 
-def _batch_pool(ht, jax, jnp, key, shape, split):
+def _register(batch: StagedBatch) -> StagedBatch:
+    """Register the staged buffer's generation with the result cache (the
+    no-readback digest for ``HEAT_TPU_RESULT_CACHE=1``; harmless metadata
+    when the tier is off)."""
+    from heat_tpu.core import _result_cache
+
+    parray = getattr(batch.value, "parray", batch.value)
+    _result_cache.register_generation(parray, batch.tag, batch.gen)
+    return batch
+
+
+def _batch_pool(ht, jax, jnp, key, shape, split, tag: str) -> List[StagedBatch]:
     return [
-        ht.array(
-            jax.random.normal(jax.random.key(key + i), shape, jnp.float32),
-            split=split,
-        )
+        _register(StagedBatch(
+            value=ht.array(
+                jax.random.normal(jax.random.key(key + i), shape, jnp.float32),
+                split=split,
+            ),
+            tag=f"wl:{tag}:{i}",
+            gen=next(_GEN_COUNTER),
+        ))
         for i in range(N_BATCH_POOL)
     ]
+
+
+def restage(batches: List[StagedBatch], slot: int, value: Any) -> StagedBatch:
+    """Replace one staged slot with ``value`` at a BUMPED generation id (the
+    rotation/upgrade event the result cache invalidates on) and return the
+    new :class:`StagedBatch`.  The old buffer's memoised results fail
+    generation validation from here on — the gate's mid-run invalidation leg
+    drives exactly this."""
+    old = batches[slot]
+    fresh = _register(StagedBatch(value=value, tag=old.tag,
+                                  gen=next(_GEN_COUNTER)))
+    batches[slot] = fresh
+    return fresh
 
 
 def build_kmeans_assign(ht, jax, jnp, smoke: bool) -> Workload:
@@ -54,13 +106,13 @@ def build_kmeans_assign(ht, jax, jnp, smoke: bool) -> Workload:
     km = ht.cluster.KMeans(n_clusters=k, init="random", max_iter=5, tol=-1.0,
                            random_state=0)
     km.fit(x)
-    batches = _batch_pool(ht, jax, jnp, 20, (batch, d), 0)
+    batches = _batch_pool(ht, jax, jnp, 20, (batch, d), 0, "kmeans_assign")
 
     def fn(i: int) -> None:
-        labels = km.predict(batches[i % N_BATCH_POOL])
+        labels = km.predict(batches[i % N_BATCH_POOL].value)
         jax.block_until_ready(labels.parray)
 
-    return Workload("kmeans_assign", fn)
+    return Workload("kmeans_assign", fn, batches)
 
 
 def build_cdist_knn(ht, jax, jnp, smoke: bool) -> Workload:
@@ -71,14 +123,14 @@ def build_cdist_knn(ht, jax, jnp, smoke: bool) -> Workload:
     # queries replicated, corpus row-split: the serving layout (a small batch
     # against a large sharded corpus; the result arrives split along the
     # corpus axis and argmin reduces over it)
-    batches = _batch_pool(ht, jax, jnp, 40, (batch, d), None)
+    batches = _batch_pool(ht, jax, jnp, 40, (batch, d), None, "cdist_knn")
 
     def fn(i: int) -> None:
-        dist = ht.spatial.cdist(batches[i % N_BATCH_POOL], corpus)
+        dist = ht.spatial.cdist(batches[i % N_BATCH_POOL].value, corpus)
         nearest = ht.argmin(dist, axis=1)
         jax.block_until_ready(nearest.parray)
 
-    return Workload("cdist_knn", fn)
+    return Workload("cdist_knn", fn, batches)
 
 
 def build_mlp_infer(ht, jax, jnp, smoke: bool) -> Workload:
@@ -87,13 +139,13 @@ def build_mlp_infer(ht, jax, jnp, smoke: bool) -> Workload:
         ht.nn.Linear(d, h), ht.nn.ReLU(), ht.nn.Linear(h, classes)
     )
     model.params  # materialise once: concurrent requests then only read
-    batches = _batch_pool(ht, jax, jnp, 50, (batch, d), 0)
+    batches = _batch_pool(ht, jax, jnp, 50, (batch, d), 0, "mlp_infer")
 
     def fn(i: int) -> None:
-        logits = model(batches[i % N_BATCH_POOL])
+        logits = model(batches[i % N_BATCH_POOL].value)
         jax.block_until_ready(logits.parray)
 
-    return Workload("mlp_infer", fn)
+    return Workload("mlp_infer", fn, batches)
 
 
 def build_sparse_matvec(ht, jax, jnp, smoke: bool) -> Workload:
@@ -110,16 +162,20 @@ def build_sparse_matvec(ht, jax, jnp, smoke: bool) -> Workload:
             a, v, dimension_numbers=(((1,), (0,)), ((), ()))
         )
     )
-    vecs = [
-        jax.random.normal(jax.random.key(70 + i), (n,), jnp.float32)
+    batches = [
+        _register(StagedBatch(
+            value=jax.random.normal(jax.random.key(70 + i), (n,), jnp.float32),
+            tag=f"wl:sparse_matvec:{i}",
+            gen=next(_GEN_COUNTER),
+        ))
         for i in range(N_BATCH_POOL)
     ]
     bcoo = mat.larray
 
     def fn(i: int) -> None:
-        jax.block_until_ready(matvec(bcoo, vecs[i % N_BATCH_POOL]))
+        jax.block_until_ready(matvec(bcoo, batches[i % N_BATCH_POOL].value))
 
-    return Workload("sparse_matvec", fn)
+    return Workload("sparse_matvec", fn, batches)
 
 
 BUILDERS = {

@@ -156,6 +156,15 @@ LOCK_POLICY: Dict[str, ModulePolicy] = {
         }},
         relaxed=set(),
     ),
+    # _result_cache.py (ISSUE 17): the generation registry / tag table and the
+    # shard-tuple rebuild mutate under the module _lock; per-shard entry state
+    # lives behind each _ShardCache._mu (class policy below). _enabled /
+    # _budget_bytes are the memoised knob cells — relaxed single-word reads on
+    # the dispatch hot path, rewritten only at reload().
+    "heat_tpu.core._result_cache": ModulePolicy(
+        locks={"_lock": {"_registry", "_tag_gen", "_shards"}},
+        relaxed={"_enabled", "_budget_bytes"},
+    ),
 }
 
 CLASS_POLICY: List[ClassPolicy] = [
@@ -180,6 +189,14 @@ CLASS_POLICY: List[ClassPolicy] = [
     # _executor._Stats: the cell list / retired / baseline fold under
     # _cells_lock (per-thread cells themselves are lock-free by design).
     ClassPolicy(_EXEC, "_Stats", "_cells_lock", {"_cells", "_retired", "_base"}),
+    # _result_cache._ShardCache (ISSUE 17): one shard's LRU map, byte
+    # occupancy and telemetry tallies mutate under the shard's own _mu (a
+    # strict leaf — never held with another shard's _mu or the module _lock).
+    ClassPolicy("heat_tpu.core._result_cache", "_ShardCache", "_mu", {
+        "_entries", "_bytes",
+        "hits", "misses", "stores", "bytes_saved", "invalidations",
+        "evictions", "replications", "rejects",
+    }),
 ]
 
 # The sanctioned lock-free accumulators: attribute writes routed through the

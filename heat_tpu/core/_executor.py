@@ -133,7 +133,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import _compile_cache, _scheduler, diagnostics, profiler, resilience, supervision
+from . import (
+    _compile_cache, _result_cache, _scheduler, diagnostics, profiler,
+    resilience, supervision,
+)
 from ._compile_cache import executor_save_warmup, executor_warmup
 from ._scheduler import PendingValue
 
@@ -370,10 +373,13 @@ def reload_env_knobs() -> None:
     knobs (``HEAT_TPU_EXEC_CACHE`` / ``HEAT_TPU_COMPILE_CACHE``) re-read here
     too, so one call covers the whole framework. ``HEAT_TPU_SCHED_SHARDS`` is
     re-read but only applied when the scheduler is (re)constructed — see
-    :func:`rebuild_scheduler`."""
+    :func:`rebuild_scheduler`. The result-memoization knobs
+    (``HEAT_TPU_RESULT_CACHE`` / ``HEAT_TPU_RESULT_CACHE_BYTES``) re-read
+    here as well — see :mod:`._result_cache`."""
     _knobs.reload()
     supervision.reload_env_knobs()
     _compile_cache.reload()
+    _result_cache.reload()
 
 
 def jit_threshold() -> int:
@@ -508,6 +514,12 @@ def _acquire_buffers(read_leaves, donate_leaves):
         diagnostics.counter(
             "executor.donation_refused", len(donate_leaves) - len(granted)
         )
+    if granted and _result_cache._enabled:
+        # the donation-epoch bump doubles as result-cache invalidation: every
+        # entry whose inputs or outputs alias a granted buffer is dropped
+        # BEFORE the donating call can consume it (a late racer is caught by
+        # the deleted-buffer re-check at hit time — never served)
+        _result_cache.note_donation([id(v) for v in granted])
     return granted
 
 
@@ -626,6 +638,17 @@ def executor_stats(top: int = 0) -> dict:
     - ``window_holds`` / ``window_widened`` / ``window_hold_ns`` — adaptive
       batch-window activity (``HEAT_TPU_BATCH_WINDOW_US``).
 
+    Cross-request result cache (``HEAT_TPU_RESULT_CACHE=1``; see
+    :mod:`._result_cache` and ``doc/source/performance.rst``):
+
+    - ``cache_hits`` / ``cache_misses`` — result-cache consults that served a
+      validated memoised value vs. fell through to execution.
+    - ``cache_bytes_saved`` — result-buffer bytes served without executing.
+    - ``cache_invalidations`` — entries dropped by generation bumps
+      (``swap_state``, batch rotation) or donation-epoch bumps.
+    - ``result_cache`` — the full per-shard block (occupancy, stores,
+      evictions, replications, typed ``cache-corrupt`` rejects).
+
     Request-lifecycle ledger (ISSUE 10; every shed/cancel/expiry is counted —
     nothing is silently dropped):
 
@@ -704,6 +727,12 @@ def executor_stats(top: int = 0) -> dict:
         stats["window_holds"] = 0
         stats["window_widened"] = 0
         stats["window_hold_ns"] = 0
+    rc = _result_cache.stats()
+    stats["result_cache"] = rc
+    stats["cache_hits"] = rc["hits"]
+    stats["cache_misses"] = rc["misses"]
+    stats["cache_bytes_saved"] = rc["bytes_saved"]
+    stats["cache_invalidations"] = rc["invalidations"]
     with _lock:
         stats["quarantined"] = dict(_quarantined)
     if top > 0:
@@ -744,15 +773,19 @@ def reset_executor_stats() -> None:
     sched = _dispatch_scheduler
     if sched is not None:
         sched.reset_stats()
+    _result_cache.reset_stats()
 
 
 def clear_executor_cache() -> None:
     """Drop every cached program (plus warm-up counts and result-aval cache)
-    AND reset all statistics: the global ``hits`` / ``misses`` / ``retraces``
-    counters are zeroed, and the per-signature breakdown of
-    ``executor_stats(top=N)`` empties because the programs carrying those
-    tallies are gone. After this call ``executor_stats()`` reports all zeros
-    and the next dispatch of any signature recompiles (a counted retrace).
+    AND the cross-request result cache (:mod:`._result_cache` — every
+    memoised result is gone, so the first post-clear read of any key is a
+    guaranteed recompute, never a stale hit), AND reset all statistics: the
+    global ``hits`` / ``misses`` / ``retraces`` counters are zeroed, and the
+    per-signature breakdown of ``executor_stats(top=N)`` empties because the
+    programs carrying those tallies are gone. After this call
+    ``executor_stats()`` reports all zeros and the next dispatch of any
+    signature recompiles (a counted retrace).
     Also one of the two documented re-read points for the memoised
     ``HEAT_TPU_*`` dispatch knobs (:func:`reload_env_knobs`)."""
     with _lock:
@@ -761,6 +794,7 @@ def clear_executor_cache() -> None:
         _quarantined.clear()
     with _aval_lock:
         _aval_cache.clear()
+    _result_cache.clear()
     reset_executor_stats()
     reload_env_knobs()
 
@@ -1008,6 +1042,23 @@ class _Program:
             # donation candidates) are still intact when the caller falls back
             resilience.maybe_fault("executor.execute")
         donating = donate and self.donate_index is not None
+        rkey = None
+        if (
+            _result_cache._enabled
+            and not donating
+            and not donate_leaves
+            and self.donate_index is None
+        ):
+            # cross-request result memoization (HEAT_TPU_RESULT_CACHE=1): the
+            # plain variant of a deterministic program is a pure function of
+            # (fingerprint, input digest) — a validated hit IS the execution.
+            # Donation-bearing variants never consult or fill (their inputs
+            # die in the call); expired deadlines raised above, before this.
+            rkey = _result_key(self, args)
+            if rkey is not None:
+                cached = _result_cache.lookup(rkey, _tenant_or_none())
+                if cached is not _result_cache.MISS:
+                    return cached
         if donate_leaves:
             variants = self._variants
             if (
@@ -1120,6 +1171,11 @@ class _Program:
         else:
             self._note_service(dt)
         self.proven = True
+        if rkey is not None:
+            # memoised only after a SUCCESSFUL plain-path execution; the
+            # entry's strong reference keeps refcount sanitation from ever
+            # proving sole ownership of a buffer the cache still serves
+            _result_cache.store(rkey, out, _tenant_or_none())
         return out
 
     def _note_service(self, dt: float, items: int = 1) -> None:
@@ -1211,6 +1267,28 @@ class _Program:
             self._note_service(dt, items=width)
         self.proven = True
         return out
+
+
+def _result_key(prog: "_Program", args) -> Optional[Tuple[str, Tuple]]:
+    """The result-cache key ``(fingerprint, input digest)`` for a plain call
+    of ``prog`` over ``args``, or None when the call is uncacheable: no
+    replay spec (warmup gap / out=-aliasing signature), an RNG-consuming
+    label, or any operand without a digest (large unregistered arrays,
+    pending async values) — see ``_result_cache`` for the documented bypass
+    contract.  The fingerprint is the compile cache's (sha256 of the
+    canonical replay spec), memoised on the program."""
+    spec = prog.spec
+    if spec is None:
+        return None
+    if _result_cache.uncacheable_label(prog.label):
+        return None
+    digest = _result_cache.digest_args(args)
+    if digest is None:
+        return None
+    fp = prog.fingerprint
+    if fp is None:
+        fp = prog.fingerprint = _compile_cache.fingerprint(spec)
+    return (fp, digest)
 
 
 def lookup(key, build: Callable[[], Any], label: Optional[str] = None,
@@ -2263,6 +2341,10 @@ def _force_sync_locked(roots: Tuple[Deferred, ...],
             raise
     else:
         donate_idx = _pick_donations(pl, prog)
+        if donate_idx and _result_cache._enabled:
+            # serialized path has no _acquire_buffers claim: invalidate the
+            # result-cache entries aliasing the donated leaves before the call
+            _result_cache.note_donation([id(pl.leaves[i]) for i in donate_idx])
         try:
             if donate_idx:
                 # donation-bearing calls never ride a retry policy: a retry
@@ -2352,6 +2434,26 @@ def _force_async(roots: Tuple[Deferred, ...],
                 return True
             donate_idx = ()
         else:
+            if _result_cache._enabled and (
+                deadline is None or time.monotonic() < deadline
+            ):
+                # result-cache consult BEFORE donation picking and queueing
+                # (HEAT_TPU_RESULT_CACHE=1): a validated hit memoises straight
+                # into the plan's nodes — no ownership claims, no scheduler
+                # round-trip, no execution.  A leaf still pending from an
+                # earlier in-flight force digests as uncacheable, and expired
+                # deadlines fall through to the typed lifecycle path below.
+                rkey = _result_key(prog, pl.leaves)
+                if rkey is not None:
+                    cached = _result_cache.lookup(
+                        rkey, _tenant_or_none(), count_miss=False
+                    )
+                    if cached is not _result_cache.MISS:
+                        outs = (cached,) if pl.single else cached
+                        if profiler._active:
+                            _record_force_memory(pl, outs)
+                        _memoise(pl, outs)
+                        return True
             donate_idx = _pick_donations(pl, prog)
         donate_set = set(donate_idx)
         read_leaves = [
@@ -2645,6 +2747,16 @@ def call_staged(key, prog: _Program, x):
         # typed DeadlineExceeded/Shed before any queueing
         prog._lifecycle_check()
         deadline = profiler.current_deadline()
+    if _result_cache._enabled and prog.donate_index is None:
+        # result-cache consult before queueing (HEAT_TPU_RESULT_CACHE=1): a
+        # validated hit skips the scheduler round-trip entirely — the inline
+        # and direct paths above consult inside prog() itself.  Admission ran
+        # above, so an expired deadline is a typed rejection, never a serve.
+        rkey = _result_key(prog, (x,))
+        if rkey is not None:
+            cached = _result_cache.lookup(rkey, tenant, count_miss=False)
+            if cached is not _result_cache.MISS:
+                return cached
     req = profiler.current_request() if profiler._active else None
     pending = PendingValue(x.shape, x.dtype)
 

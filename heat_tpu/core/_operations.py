@@ -26,7 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import _executor, diagnostics, profiler, sanitation, types
+from . import _executor, _result_cache, diagnostics, profiler, sanitation, types
 from .communication import get_comm
 from .devices import get_device
 from .dndarray import DNDarray
@@ -493,6 +493,10 @@ def _binary_jit(
         _note_pad_waste(out_shape, out_split, comm)
     try:
         if has_out:
+            if donate and _result_cache._enabled:
+                # out= donation consumes the destination buffer: drop every
+                # memoised result aliasing it before XLA invalidates it
+                _result_cache.note_donation((id(out.parray),))
             value = prog(*vals, out.parray, donate=donate)
             out._rebind_physical(value)
             return out
@@ -608,6 +612,10 @@ def _local_jit(operation, x, out, fn_kwargs):
     if kind == "out":
         sanitation.sanitize_out(out, gshape, split, x.device)
         donate = sanitation.sanitize_donation(out, [xval])
+        if donate and _result_cache._enabled:
+            # out= donation consumes the destination buffer: drop every
+            # memoised result aliasing it before XLA invalidates it
+            _result_cache.note_donation((id(out.parray),))
         try:
             value = prog(xval, out.parray, donate=donate)
         except Exception as exc:
@@ -741,6 +749,10 @@ def _reduce_jit(operation, x, axis, out_split, out, keepdims, fn_kwargs):
     if kind == "out":
         sanitation.sanitize_out(out, rshape, fsplit, x.device)
         donate = sanitation.sanitize_donation(out, [xval])
+        if donate and _result_cache._enabled:
+            # out= donation consumes the destination buffer: drop every
+            # memoised result aliasing it before XLA invalidates it
+            _result_cache.note_donation((id(out.parray),))
         try:
             value = prog(xval, out.parray, donate=donate)
         except Exception as exc:
@@ -839,6 +851,10 @@ def _cum_jit(operation, x, axis, out, target, fn_kwargs):
     if prog.meta == ("out",):
         sanitation.sanitize_out(out, gshape, split, x.device)
         donate = sanitation.sanitize_donation(out, [xval])
+        if donate and _result_cache._enabled:
+            # out= donation consumes the destination buffer: drop every
+            # memoised result aliasing it before XLA invalidates it
+            _result_cache.note_donation((id(out.parray),))
         try:
             value = prog(xval, out.parray, donate=donate)
         except Exception as exc:

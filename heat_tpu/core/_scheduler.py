@@ -699,6 +699,22 @@ class _Shard:
             self.tenant_lifecycle = {}
 
 
+def shard_index_for(affinity, shards: int) -> int:
+    """The shard index ``affinity`` (a tenant tag, or None for untagged work)
+    hash-affines to among ``shards`` slots — the ONE affinity function shared
+    by the dispatch queue (:meth:`DispatchScheduler._shard_for`) and the
+    result cache's per-shard slices (``_result_cache``), so a tenant's cache
+    shard is always the shard its dispatches drain on.  Untagged work
+    normalises to the ``t<thread-id>`` fallback tenant the executor uses."""
+    if shards <= 1:
+        return 0
+    if affinity is None:
+        affinity = f"t{threading.get_ident()}"
+    elif not isinstance(affinity, str):
+        affinity = f"t{affinity}"
+    return zlib.crc32(affinity.encode("utf-8", "surrogatepass")) % shards
+
+
 class DispatchScheduler:
     """The sharded fair bounded dispatch queue plus its per-shard drain
     threads.
@@ -735,14 +751,7 @@ class DispatchScheduler:
         executor uses as its fallback tenant, so an inline claim and a
         queued item from one untagged thread always meet on one shard."""
         shards = self._shards
-        if len(shards) == 1:
-            return shards[0]
-        if affinity is None:
-            affinity = f"t{threading.get_ident()}"
-        elif not isinstance(affinity, str):
-            affinity = f"t{affinity}"
-        idx = zlib.crc32(affinity.encode("utf-8", "surrogatepass"))
-        return shards[idx % len(shards)]
+        return shards[shard_index_for(affinity, len(shards))]
 
     # ------------------------------------------------------------- submission
     def try_inline(self, affinity=None) -> Optional[_Shard]:

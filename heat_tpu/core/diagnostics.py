@@ -21,6 +21,12 @@ explain themselves. This module is the registry those hooks report into:
 - **Executor telemetry** — per-signature compile wall time, and miss events
   annotated with the *reason*: which signature component (operand aval, split,
   kwargs, mesh, …) changed versus the nearest cached key.
+- **Result-cache counters** (``HEAT_TPU_RESULT_CACHE=1``; see
+  :mod:`_result_cache`) — ``executor.result_cache_hit`` /
+  ``executor.result_cache_store`` / ``executor.result_cache_invalidation`` /
+  ``executor.result_cache_reject`` ride :func:`counter`; a poisoned entry is
+  additionally a typed ``cache-corrupt`` resilience event through
+  :func:`record_resilience_event`, the same contract as the compile cache.
 - **Padded-layout waste gauges** — the dispatch wrappers record the pad
   fraction ``(physical - logical) / physical`` of every padded ``(gshape,
   split)`` family they dispatch on.

@@ -280,6 +280,10 @@ _AUTO_DUMP_KINDS = frozenset({
     "coordination-timeout",  # a supervised coordination wait exhausted
     "peer-dead",             # the injected peer-death fault fired (this rank)
     "peer-failover",         # a serving pool shed typed after a peer failure
+    # deliberately NOT here: "cache-corrupt" — a corrupt compile-cache or
+    # result-cache entry is self-healing (typed rejection, then recompile /
+    # recompute), so it rides the ring as post-mortem context without
+    # spending dump budget on a failure the very next dispatch repairs
 })
 
 

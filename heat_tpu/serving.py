@@ -41,7 +41,9 @@ import time
 from typing import Any, Optional
 
 from .core import checkpoint as _checkpoint
-from .core import diagnostics, profiler, resilience, supervision, telemetry
+from .core import (
+    _result_cache, diagnostics, profiler, resilience, supervision, telemetry,
+)
 from .core.resilience import SwapFailed
 
 __all__ = ["ModelPool", "SwapFailed", "swap_state"]
@@ -51,6 +53,21 @@ def _scheduler():
     from .core import _executor
 
     return _executor._get_scheduler()
+
+
+def _iter_array_leaves(tree: Any, path: str):
+    """Depth-first ``(path, jax buffer)`` pairs of a state pytree's DNDarray
+    leaves — deterministic order, so one leaf always carries one tag."""
+    parray = getattr(tree, "parray", None)
+    if parray is not None:
+        yield path, parray
+        return
+    if isinstance(tree, dict):
+        for key in sorted(tree, key=str):
+            yield from _iter_array_leaves(tree[key], f"{path}.{key}")
+    elif isinstance(tree, (list, tuple)):
+        for i, item in enumerate(tree):
+            yield from _iter_array_leaves(item, f"{path}[{i}]")
 
 
 class ModelPool:
@@ -72,6 +89,7 @@ class ModelPool:
         self._swaps = 0
         self._rollbacks = 0
         self._failovers = 0
+        self._swap_gen = 0  # monotonic rebind counter: the result-cache generation
 
     @property
     def state(self) -> Any:
@@ -125,9 +143,24 @@ class ModelPool:
         return stats
 
     def _rebind(self, state: Any, generation: Optional[str]) -> None:
+        with self._lock:
+            self._swap_gen += 1
+            gen = self._swap_gen
+        # generation-wire the result cache BEFORE the reference swap: each new
+        # state leaf registers under its pool tag at the bumped generation, so
+        # from this point no entry keyed on an older generation can validate —
+        # a racing hit fails closed and recomputes, never serves stale state
+        for tag, leaf in _iter_array_leaves(state, "state"):
+            _result_cache.register_generation(
+                leaf, f"pool:{self.name}:{tag}", gen
+            )
         self._state = state
         with self._lock:
             self._generation = generation
+        # eager sweep of the stale generation's entries (the lazy per-hit
+        # validation above is the correctness barrier; the sweep keeps the
+        # byte budget from carrying dead weight and feeds cache_invalidations)
+        _result_cache.invalidate_prefix(f"pool:{self.name}")
 
     def _note_swap(self, entry: dict) -> None:
         with self._lock:
