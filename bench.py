@@ -717,6 +717,74 @@ def _bench_telemetry(timeout_s: float = 300.0) -> dict:
     return rec
 
 
+def _bench_ops(timeout_s: float = 300.0) -> dict:
+    """A hermetic ops-plane self-test gauge for ``extra_metrics``: a
+    virtual-CPU-mesh child arms ``ht.ops`` with the HTTP endpoint up, runs a
+    profiled request against a deliberately impossible SLO, takes one sample,
+    and proves the whole live path — a parseable OpenMetrics page over real
+    HTTP, the admitted/shed/failed ledger reconciling, and the burn alert
+    tripped. Host-side only — records every round, relay up or down."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        "import json, urllib.request\n"
+        "import heat_tpu as ht\n"
+        "from heat_tpu.core import _executor, ops, profiler\n"
+        "profiler.enable()\n"
+        "ops.arm(start_thread=False)\n"
+        "ops.set_slo('selftest', p99_ms=0.001)\n"  # impossible: must burn
+        "with profiler.request('selftest'):\n"
+        "    x = ht.arange(1001, split=0)\n"
+        "    (x * 2.0).sum().parray\n"
+        "s = ops.sample_once()\n"
+        "addr = ops.http_address()\n"
+        "body = urllib.request.urlopen('http://%s:%d/metrics' % addr,\n"
+        "                              timeout=10).read().decode()\n"
+        "fams = ops.parse_openmetrics(body)\n"
+        "ex = _executor.executor_stats()\n"
+        "ledger_ok = (s['totals']['admitted'] ==\n"
+        "             ex.get('inline_dispatches', 0) + ex.get('queued_dispatches', 0))\n"
+        "print(json.dumps({'families': len(fams),\n"
+        "                  'sampled': s is not None,\n"
+        "                  'ledger_ok': ledger_ok,\n"
+        "                  'alert': ops.slo_status()['selftest']['alert']}))\n"
+    )
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=3",
+                   HEAT_TPU_OPS_PORT="0",
+                   HEAT_TPU_FLIGHT_DIR=os.path.join(td, "flight"))
+        env.pop("HEAT_TPU_FAULT_PLAN", None)
+        env.pop("HEAT_TPU_OPS", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s, cwd=here, env=env,
+        )
+        gauges = {}
+        if proc.returncode == 0:
+            try:
+                gauges = json.loads(proc.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                pass
+    ok = bool(gauges) and gauges.get("families", 0) >= 5 and \
+        gauges.get("sampled") and gauges.get("ledger_ok") and \
+        gauges.get("alert")
+    rec = {
+        "metric": "ops_selftest",
+        "value": 1 if ok else 0,
+        "unit": "ok",
+        **gauges,
+    }
+    if proc.returncode != 0:
+        rec["error"] = f"rc={proc.returncode}: {proc.stderr[-400:]}"
+    return rec
+
+
 def main():
     import sys
     import traceback
@@ -752,6 +820,10 @@ def main():
         traceback.print_exc(file=sys.stderr)
     try:
         dispatch_extras.append(_bench_telemetry())
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    try:
+        dispatch_extras.append(_bench_ops())
     except Exception:
         traceback.print_exc(file=sys.stderr)
 

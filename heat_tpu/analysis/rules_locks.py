@@ -147,6 +147,20 @@ LOCK_POLICY: Dict[str, ModulePolicy] = {
         acquire_fns={"_lock_acquire": "_lock"},
         lock_aliases={"_tlock": "_lock"},
     ),
+    # ops.py (ISSUE 18) "Thread-safety" section: the sample ring, baseline
+    # snapshot, SLO/alert tables and daemon handles mutate under the
+    # (strictly leaf) module _lock — cross-module snapshots are gathered
+    # before taking it, alert events emitted after releasing it; _armed is
+    # the relaxed observer gate read bare by the supervision beat tee, and
+    # _knobs the memoised env-knob cell like the executor's.
+    "heat_tpu.core.ops": ModulePolicy(
+        locks={"_lock": {
+            "_ring", "_prev_cum", "_samples_total", "_delta_resets",
+            "_slos", "_alerts", "_thread", "_thread_stop", "_server",
+            "_server_thread",
+        }},
+        relaxed={"_armed", "_knobs"},
+    ),
     # _compile_cache.py (ISSUE 15): the memoised cache-dir knob, the lazy
     # in-memory index, and the applied jax-cache marker mutate under the
     # (strictly leaf) module _lock; reload() is the documented re-read point.
@@ -185,6 +199,9 @@ CLASS_POLICY: List[ClassPolicy] = [
         "stolen_batch_items", "window_holds", "window_widened",
         "window_hold_ns", "lifecycle", "tenant_lifecycle",
         "_gap_ewma_s", "_last_submit",
+        # pressure EWMAs (ISSUE 18): exact under _cv like every shard cell;
+        # surfaced through executor_stats()["pressure"]
+        "_depth_ewma", "_shed_ewma",
     }),
     # _executor._Stats: the cell list / retired / baseline fold under
     # _cells_lock (per-thread cells themselves are lock-free by design).

@@ -134,7 +134,7 @@ import jax
 import jax.numpy as jnp
 
 from . import (
-    _compile_cache, _result_cache, _scheduler, diagnostics, profiler,
+    _compile_cache, _result_cache, _scheduler, diagnostics, ops, profiler,
     resilience, supervision,
 )
 from ._compile_cache import executor_save_warmup, executor_warmup
@@ -375,11 +375,13 @@ def reload_env_knobs() -> None:
     re-read but only applied when the scheduler is (re)constructed — see
     :func:`rebuild_scheduler`. The result-memoization knobs
     (``HEAT_TPU_RESULT_CACHE`` / ``HEAT_TPU_RESULT_CACHE_BYTES``) re-read
-    here as well — see :mod:`._result_cache`."""
+    here as well — see :mod:`._result_cache`. The live-operations knobs
+    (``HEAT_TPU_OPS*``) re-read here too — see :mod:`.ops`."""
     _knobs.reload()
     supervision.reload_env_knobs()
     _compile_cache.reload()
     _result_cache.reload()
+    ops.reload()
 
 
 def jit_threshold() -> int:
@@ -579,6 +581,56 @@ def rebuild_scheduler() -> _scheduler.DispatchScheduler:
     return sched
 
 
+#: hot signatures carried in the pressure block (bounded: the block rides in
+#: every ops sample and cluster beat, so it must stay compact)
+_PRESSURE_TOP_SIGNATURES = 8
+
+
+def _pressure_block(per_shard: Sequence[dict]) -> dict:
+    """The autoscaler-facing pressure contract (``executor_stats()
+    ["pressure"]``): per-shard queue-depth / shed-rate / submit-gap EWMAs plus
+    the service-time EWMA of the hottest compiled signatures.
+
+    Lock policy — exact vs relaxed, spelled out because the two halves
+    deliberately differ:
+
+    * The per-shard EWMAs are **exact at copy time**: each shard's cells are
+      read under its own ``_cv`` by ``snapshot_locked_copy`` (the same fold
+      every other scheduler stat takes), so a shard's depth/shed/gap triple
+      is internally consistent, though shards are sampled at slightly
+      different instants.
+    * The per-signature ``service_ewma_s`` values are **deliberately
+      relaxed**: ``_Program.ewma_s`` is a last-writer-wins cell updated by
+      whichever thread replays the program (admission feasibility checks read
+      it bare the same way). Only the program-table *iteration* is under
+      ``_lock``; the EWMA reads are bare — a torn read is impossible for a
+      Python float reference, and a stale one is exactly as stale as the
+      admission controller already tolerates."""
+    pressure_shards = [
+        {
+            "shard": i,
+            "queue_depth": snap["queue_depth"],
+            "depth_ewma": round(snap["depth_ewma"], 6),
+            "shed_rate_ewma": round(snap["shed_rate_ewma"], 6),
+            "gap_ewma_s": round(snap["gap_ewma_s"], 9),
+        }
+        for i, snap in enumerate(per_shard)
+    ]
+    with _lock:
+        entries = [
+            (entry.label or _key_label(key), entry.hits, entry.ewma_s)
+            for key, entry in _programs.items()
+            if entry is not UNSUPPORTED
+        ]
+    entries.sort(key=lambda e: (-e[1], e[0]))
+    service = {
+        label: round(ewma, 9)
+        for label, hits, ewma in entries[:_PRESSURE_TOP_SIGNATURES]
+        if ewma > 0.0
+    }
+    return {"per_shard": pressure_shards, "service_ewma_s": service}
+
+
 def executor_stats(top: int = 0) -> dict:
     """Cache introspection: ``hits`` / ``misses`` (signature-table lookups),
     ``retraces`` (times a program body was actually traced — 0 between two
@@ -637,6 +689,11 @@ def executor_stats(top: int = 0) -> dict:
       queues by cross-shard work-stealing.
     - ``window_holds`` / ``window_widened`` / ``window_hold_ns`` — adaptive
       batch-window activity (``HEAT_TPU_BATCH_WINDOW_US``).
+    - ``pressure`` — the autoscaler-facing live-pressure contract (ISSUE 18;
+      consumed by :mod:`.ops` but useful with the ops plane off): per-shard
+      queue-depth / shed-rate / submit-gap EWMAs plus the service-time EWMA
+      per hot signature — see :func:`_pressure_block` for the exact-vs-relaxed
+      lock policy.
 
     Cross-request result cache (``HEAT_TPU_RESULT_CACHE=1``; see
     :mod:`._result_cache` and ``doc/source/performance.rst``):
@@ -708,6 +765,7 @@ def executor_stats(top: int = 0) -> dict:
         stats["window_holds"] = sstats["window_holds"]
         stats["window_widened"] = sstats["window_widened"]
         stats["window_hold_ns"] = sstats["window_hold_ns"]
+        stats["pressure"] = _pressure_block(sstats["per_shard"])
     else:
         stats["queue_depth_peak"] = 0
         stats["batched_requests"] = 0
@@ -727,6 +785,7 @@ def executor_stats(top: int = 0) -> dict:
         stats["window_holds"] = 0
         stats["window_widened"] = 0
         stats["window_hold_ns"] = 0
+        stats["pressure"] = _pressure_block([])
     rc = _result_cache.stats()
     stats["result_cache"] = rc
     stats["cache_hits"] = rc["hits"]

@@ -289,6 +289,15 @@ class _Shard:
         # (seconds); 0 until two submits have been seen
         self._gap_ewma_s = 0.0
         self._last_submit: Optional[float] = None
+        # pressure EWMAs (the autoscaler-facing contract surfaced through
+        # ``executor_stats()["pressure"]``; same alpha as the gap EWMA):
+        # queue-depth EWMA advances toward the post-enqueue depth on every
+        # accepted submit; the shed-rate EWMA is driven toward 1.0 by each
+        # shed and toward 0.0 by each accepted submit, so it reads as "the
+        # recent fraction of admission decisions that shed". Both mutate
+        # under ``_cv`` (exact at snapshot time, like every shard cell).
+        self._depth_ewma = 0.0
+        self._shed_ewma = 0.0
 
     # ------------------------------------------------------------- submission
     def try_inline_locked_claim(self) -> bool:
@@ -341,6 +350,8 @@ class _Shard:
                 gap = now - last
                 prev = self._gap_ewma_s
                 self._gap_ewma_s = gap if prev <= 0.0 else prev + 0.25 * (gap - prev)
+            self._depth_ewma += 0.25 * (self._depth - self._depth_ewma)
+            self._shed_ewma += 0.25 * (0.0 - self._shed_ewma)
             depth = self._depth
             self._ensure_thread_locked()
             self._cv.notify_all()
@@ -520,6 +531,9 @@ class _Shard:
                                 n: int = 1) -> int:
         """Account ``n`` lifecycle events of ``kind`` in THIS shard's cells;
         returns the shard's new total. Under _cv."""
+        if kind == "shed":
+            for _ in range(n):
+                self._shed_ewma += 0.25 * (1.0 - self._shed_ewma)
         self.lifecycle[kind] += n
         if tenant is not None:
             per = self.tenant_lifecycle.get(tenant)
@@ -680,6 +694,11 @@ class _Shard:
                 "tenant_lifecycle": {
                     t: dict(per) for t, per in self.tenant_lifecycle.items()
                 },
+                # pressure EWMAs: per-shard ONLY — EWMAs do not sum, so
+                # DispatchScheduler.stats never folds them into the totals
+                "gap_ewma_s": self._gap_ewma_s,
+                "depth_ewma": self._depth_ewma,
+                "shed_rate_ewma": self._shed_ewma,
             }
 
     def reset_stats(self) -> None:
@@ -697,6 +716,10 @@ class _Shard:
             self.window_hold_ns = 0
             self.lifecycle = {k: 0 for k in LIFECYCLE_KINDS}
             self.tenant_lifecycle = {}
+            # _gap_ewma_s is deliberately NOT reset: it is the adaptive
+            # batch window's control signal, not a statistic
+            self._depth_ewma = 0.0
+            self._shed_ewma = 0.0
 
 
 def shard_index_for(affinity, shards: int) -> int:

@@ -35,6 +35,11 @@ explain themselves. This module is the registry those hooks report into:
   (:func:`relay_outage_windows`). ``bench.py`` and ``__graft_entry__`` feed
   this stream so a null benchmark round is attributable to a measured outage
   window rather than silence.
+- **Provider sections** — :func:`register_provider` attaches named report
+  sections computed at :func:`report` time; the executor, resilience,
+  supervision and the live operations plane (:mod:`ops` — whose ``slo-burn``
+  alert transitions also arrive as typed events through
+  :func:`record_resilience_event`) all report through this hook.
 
 Zero-cost contract
 ------------------

@@ -270,6 +270,22 @@ class Histogram:
                 return min(max(est, self.min_s), self.max_s)
         return self.max_s
 
+    def count_over(self, threshold_s: float) -> int:
+        """How many observed samples exceeded ``threshold_s`` — the SLO
+        burn-rate numerator (``ht.ops`` counts a window's requests over the
+        tenant's p99 objective with this). Bucket-resolution: a whole bucket
+        counts as over when its LOWER bound is at or above the threshold, so
+        the answer is exact whenever the threshold lands on a bucket boundary
+        and otherwise errs by at most the one straddling bucket (under — the
+        conservative direction for alerting on latency)."""
+        threshold_s = max(0.0, float(threshold_s))
+        total = 0
+        for i, c in self.buckets.items():
+            lower = self._bound(i - 1) if i > 0 else 0.0
+            if lower >= threshold_s:
+                total += c
+        return total
+
     def snapshot(self) -> dict:
         """A JSON-able summary: counts, extremes, p50/p95/p99, and the sparse
         bucket table (``[[index, count], …]`` with the bucket config) so a

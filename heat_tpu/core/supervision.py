@@ -138,6 +138,7 @@ __all__ = [
     "abort_error",
     "aborted",
     "post_abort",
+    "current_monitor",
     "forget_peer",
     "watch",
     "kv_wait",
@@ -169,6 +170,14 @@ _monitor: Optional["Monitor"] = None
 _thread: Optional[threading.Thread] = None
 _thread_stop: Optional[threading.Event] = None
 _generation: int = 0
+
+# Late-bound collaborator hook (the diagnostics tee pattern): ``ht.ops``
+# installs its beat publisher here at ITS import so every monitor tick also
+# carries the rank's compact ops beat on the same KV channel — this module
+# cannot import ops (that would be a cycle). Written once, read bare; the
+# tee itself gates on ``ops._armed``, so the idle cost per tick is one
+# foreign attribute load + branch.
+_ops_tee: Optional[Callable[["Monitor"], None]] = None
 
 # watchdog: token -> (site, start_monotonic, deadline_monotonic); tokens the
 # scan flagged overdue move to _watch_fired so the stuck rank raises typed
@@ -654,6 +663,14 @@ class Monitor:
         except Exception as exc:
             record_resilience_event("supervision.heartbeat", "beat-unpublished",
                     f"{type(exc).__name__}: {exc}")
+        tee = _ops_tee
+        if tee is not None:
+            try:
+                tee(self)
+            except Exception as exc:
+                record_resilience_event(
+                    "supervision.heartbeat", "ops-beat-unpublished",
+                    f"{type(exc).__name__}: {exc}")
         try:
             self.check_sentinel()
             if not _aborted:
@@ -732,6 +749,14 @@ def arm(coordinator=None, *, rank: Optional[int] = None,
             f"rank {rank}/{nprocs}, peer_timeout {monitor.peer_timeout_s:.3f}s,"
             f" generation {_generation}")
     return monitor
+
+
+def current_monitor() -> Optional["Monitor"]:
+    """The armed :class:`Monitor`, or None — the handle ``ht.ops`` folds
+    cluster beats through (``cluster_snapshot`` sweeps ``<ns>/ops/`` on its
+    coordinator)."""
+    with _lock:
+        return _monitor
 
 
 def disarm() -> None:

@@ -173,6 +173,8 @@ __all__ = [
     "dump_shard",
     "shard_payload",
     "load_shards",
+    "load_ops_beats",
+    "OPS_BEAT_PREFIX",
     "merge",
     "merged_trace",
     "write_report",
@@ -280,6 +282,13 @@ _AUTO_DUMP_KINDS = frozenset({
     "coordination-timeout",  # a supervised coordination wait exhausted
     "peer-dead",             # the injected peer-death fault fired (this rank)
     "peer-failover",         # a serving pool shed typed after a peer failure
+    "slo-burn",              # an ops-plane tenant burn-rate alert went UP
+                             # (ISSUE 18): the transition event's detail
+                             # carries the offending window's per-shard
+                             # pressure breakdown, so the post-mortem shows
+                             # WHERE the budget burned — only the OFF->ON
+                             # edge is typed (clears ride the ring without
+                             # dumping), so one regression dumps exactly once
     # deliberately NOT here: "cache-corrupt" — a corrupt compile-cache or
     # result-cache entry is self-healing (typed rejection, then recompile /
     # recompute), so it rides the ring as post-mortem context without
@@ -1186,15 +1195,123 @@ def write_trace(trace: dict, path: str) -> str:
                         indent=None, sort_keys=False)
 
 
+# ------------------------------------------------------------------ ops beats
+#: filename prefix of per-rank ops-beat files (must match ``ops.BEAT_PREFIX``;
+#: duplicated here because a standalone file-path load of this module has no
+#: package to import ops from — tests/test_ops.py asserts the two agree)
+OPS_BEAT_PREFIX = "ops-beat-r"
+
+
+def load_ops_beats(directory: str) -> Dict[str, dict]:
+    """Read every ``ops-beat-r<rank>.json`` under ``directory`` into
+    ``{rank: beat}`` — one LATEST beat per rank (each write replaces the
+    rank's file atomically, so there is never more than one). Unparseable
+    files raise: a torn beat must not pass silently as a healthy rank."""
+    out: Dict[str, dict] = {}
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith(OPS_BEAT_PREFIX) and name.endswith(".json")):
+            continue
+        path = os.path.join(directory, name)
+        with open(path) as f:
+            beat = json.load(f)
+        rank = str(beat.get("rank", name[len(OPS_BEAT_PREFIX):-len(".json")]))
+        out[rank] = beat
+    return out
+
+
+def _fold_ops_section(beats: Dict[str, dict]) -> dict:
+    """The ``ops`` section of a merged report (``merge --from-ops``): the
+    per-rank beats plus sums of the WINDOWED rates.
+
+    Disjointness rule (why folding live scrapes alongside shards cannot
+    double-count): the shard counters merged above are CUMULATIVE since
+    process start, while every ops number is a windowed delta/rate over the
+    last sample interval — the two live in different units over different
+    spans, so they land in disjoint report sections (``counters`` /
+    ``executor`` vs ``ops``) and are never added together. One beat per rank
+    (latest-wins files), so the cross-rank sums here are exact for the
+    beats' own windows."""
+    ranks = {r: beats[r] for r in sorted(beats, key=lambda x: (len(x), x))}
+    totals = {"rps": 0.0, "shed_rate": 0.0, "queue_depth": 0}
+    alerts = []
+    for rank, beat in ranks.items():
+        totals["rps"] += beat.get("rps") or 0.0
+        totals["shed_rate"] += beat.get("shed_rate") or 0.0
+        totals["queue_depth"] += beat.get("queue_depth") or 0
+        for tenant, cell in (beat.get("tenants") or {}).items():
+            if cell.get("alert"):
+                alerts.append({"rank": rank, "tenant": tenant,
+                               "burn_1m": cell.get("burn_1m")})
+    return {
+        "schema": "heat-tpu-ops-merged/1",
+        "ranks": ranks,
+        "totals": {k: round(v, 6) if isinstance(v, float) else v
+                   for k, v in totals.items()},
+        "alerts": alerts,
+    }
+
+
+def _render_top(ranks: Dict[str, dict]) -> str:
+    """The ``telemetry top`` table: one row per rank, nested rows per tenant
+    with SLO state — the terminal view of :func:`heat_tpu.core.ops
+    .cluster_snapshot`."""
+    lines = [f"{'RANK':>4}  {'RPS':>8}  {'SHED/S':>8}  {'HIT%':>6}  "
+             f"{'DEPTH':>5}  {'DRAIN':>5}  {'SEQ':>6}"]
+    for rank in sorted(ranks, key=lambda r: (len(r), r)):
+        beat = ranks[rank]
+        hit = beat.get("cache_hit_rate")
+        lines.append(
+            f"{rank:>4}  {beat.get('rps') or 0.0:>8.2f}  "
+            f"{beat.get('shed_rate') or 0.0:>8.2f}  "
+            f"{(hit * 100 if hit is not None else float('nan')):>6.1f}  "
+            f"{beat.get('queue_depth') or 0:>5d}  "
+            f"{'yes' if beat.get('draining') else '-':>5}  "
+            f"{beat.get('seq') or 0:>6d}")
+        for tenant, cell in sorted((beat.get("tenants") or {}).items()):
+            p99 = cell.get("p99_ms")
+            burn = cell.get("burn_1m")
+            lines.append(
+                f"      {tenant:<16} p99 "
+                f"{(f'{p99:.2f}ms' if p99 is not None else '-'):>10}  "
+                f"burn1m {(f'{burn:.2f}' if burn is not None else '-'):>6}  "
+                f"{'ALERT' if cell.get('alert') else 'ok'}")
+    return "\n".join(lines)
+
+
+def _top_once(directory: Optional[str]) -> Tuple[int, str]:
+    """One ``top`` refresh: beats from ``--dir`` files, else the live
+    cluster fold over the coordination channel."""
+    if directory:
+        ranks = load_ops_beats(directory)
+        if not ranks:
+            return 1, f"no {OPS_BEAT_PREFIX}*.json beats under {directory}"
+        return 0, _render_top(ranks)
+    try:
+        from . import ops
+    except ImportError:
+        return 1, ("telemetry top needs --dir in a standalone load "
+                   "(no package to reach the live ops plane through)")
+    snap = ops.cluster_snapshot()
+    return 0, _render_top(snap["ranks"])
+
+
 # ------------------------------------------------------------------ CLI
 def main(argv: Optional[Sequence[str]] = None) -> int:
     """``python -m heat_tpu.telemetry merge --dir D [--out R] [--trace-out T]
-    [--expect N] [--check]`` — fold a directory of per-process shards into one
-    report (and optionally one merged trace). Unreadable/torn/inconsistent
-    shards always exit non-zero. ``--expect`` fails unless exactly N shards
-    merged; ``--check`` (the CI gate) additionally requires a COMPLETE job —
-    one shard per process recorded in the shards themselves — so a partial
-    collection cannot pass as a global report."""
+    [--expect N] [--check] [--from-ops DIR]`` — fold a directory of
+    per-process shards into one report (and optionally one merged trace).
+    Unreadable/torn/inconsistent shards always exit non-zero. ``--expect``
+    fails unless exactly N shards merged; ``--check`` (the CI gate)
+    additionally requires a COMPLETE job — one shard per process recorded in
+    the shards themselves — so a partial collection cannot pass as a global
+    report. ``--from-ops`` folds a directory of live ops-beat files into the
+    report's separate ``ops`` section (windowed rates; disjoint from the
+    cumulative shard counters by construction — see ``_fold_ops_section``).
+
+    ``python -m heat_tpu.telemetry top [--dir D] [--watch N]`` — render the
+    per-rank / per-tenant live operations table: from ``ops-beat-r*.json``
+    files under ``--dir``, or (no ``--dir``) from the live cluster fold over
+    the jax.distributed coordination channel (``ops.cluster_snapshot``)."""
     import argparse
 
     parser = argparse.ArgumentParser(prog="python -m heat_tpu.telemetry")
@@ -1212,7 +1329,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "ordered site list per request tag on every rank, the "
                     "runtime twin of the static spmd-divergent-collective "
                     "rule; a divergence names the first diverging rank/site")
+    mp.add_argument("--from-ops", metavar="DIR", default=None,
+                    help="also fold ops-beat-r*.json live-scrape files from "
+                    "DIR into the report's `ops` section (windowed "
+                    "rates/deltas — disjoint from the cumulative shard "
+                    "counters, so nothing is double-counted)")
+    tp = sub.add_parser("top", help="render the per-rank/per-tenant live "
+                        "operations table")
+    tp.add_argument("--dir", default=None,
+                    help="read ops-beat-r*.json files instead of the live "
+                    "coordination channel")
+    tp.add_argument("--watch", type=float, default=None, metavar="SECONDS",
+                    help="refresh every N seconds until interrupted")
     args = parser.parse_args(argv)
+
+    if args.cmd == "top":
+        try:
+            while True:
+                rc, text = _top_once(args.dir)
+                if args.watch is not None and rc == 0:
+                    print("\x1b[2J\x1b[H", end="")
+                print(text)
+                if args.watch is None or rc != 0:
+                    return rc
+                time.sleep(max(0.1, args.watch))
+        except KeyboardInterrupt:
+            return 0
+        except OSError as exc:
+            print(f"telemetry top FAILED: {type(exc).__name__}: {exc}")
+            return 1
 
     try:
         shards = load_shards(args.dir)
@@ -1230,6 +1375,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     f"{recorded}-process job"
                 )
         report = merge(shards)
+        if args.from_ops:
+            report["ops"] = _fold_ops_section(load_ops_beats(args.from_ops))
         # the trace is the expensive half (every slice re-serialised): only
         # build it when someone asked for it
         trace = merged_trace(shards) if args.trace_out else None

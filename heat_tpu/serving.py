@@ -42,7 +42,8 @@ from typing import Any, Optional
 
 from .core import checkpoint as _checkpoint
 from .core import (
-    _result_cache, diagnostics, profiler, resilience, supervision, telemetry,
+    _result_cache, diagnostics, ops, profiler, resilience, supervision,
+    telemetry,
 )
 from .core.resilience import SwapFailed
 
@@ -188,6 +189,23 @@ class ModelPool:
         entries carry ``kind: "peer-failover"`` instead of from/to)."""
         with self._lock:
             return [dict(e) for e in self._ledger]
+
+    def set_slo(self, tenant: str, *, p99_ms: Optional[float] = None,
+                success_ratio: Optional[float] = None) -> None:
+        """Register ``tenant``'s serving objectives with the live operations
+        plane (:func:`heat_tpu.core.ops.set_slo`): the ops sampler then
+        tracks 1m/5m error-budget burn rates for the tenant's
+        ``profiler.request(tag)`` traffic, raises the typed ``slo-burn``
+        alert (with its flight post-mortem) when both windows burn above
+        1.0, and exports the ``ht_slo_burn_rate`` series. The pool is the
+        natural registration point — it knows its tenants — but the SLO
+        lives on the process-wide plane, not the pool."""
+        ops.set_slo(tenant, p99_ms=p99_ms, success_ratio=success_ratio)
+
+    def slo_status(self) -> dict:
+        """The declared objectives with their latest burn rates and alert
+        states (:func:`heat_tpu.core.ops.slo_status`)."""
+        return ops.slo_status()
 
     @staticmethod
     def _forget_failed_peer(exc: BaseException) -> None:

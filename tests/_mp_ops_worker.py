@@ -1,0 +1,131 @@
+"""Multi-controller ops-plane worker: the cluster-beat proof (ISSUE 18).
+
+Launched by tests/test_multiprocess.py with
+``python _mp_ops_worker.py <coordinator> <num_processes> <process_id>
+<tmpdir>``. One SPMD process of an N-process job:
+
+1. Every rank arms ``ht.ops`` (sampler thread off — ticks are driven
+   deterministically), declares a per-rank tenant SLO, scopes a profiled
+   request, and takes one real sample.
+2. Every rank publishes its compact beat under ``<ns>/ops/<rank>`` on the
+   REAL jax.distributed coordination KV channel (the supervision monitor's
+   namespace — the same channel the heartbeat tee piggybacks).
+3. The LAST rank publishes LATE (the mid-drain stand-in). Every other rank
+   proves ``cluster_snapshot`` is non-blocking — the sweep returns
+   immediately with whatever beats exist, it never waits for the laggard —
+   then polls until all N beats fold, and asserts every rank's schema, rank
+   field, and its own tenant cell.
+4. Every rank writes its beat file; rank 0 renders the whole cluster through
+   the public ``python -m heat_tpu.telemetry top --dir`` surface and asserts
+   one table row per rank.
+
+Prints ``OPS_OK <pid>`` on success. Any assertion failure exits non-zero and
+fails the parent test.
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> None:
+    coordinator, nprocs, pid, tmpdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    )
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    os.environ["HEAT_TPU_COORDINATOR_ADDRESS"] = coordinator
+    os.environ["HEAT_TPU_NUM_PROCESSES"] = str(nprocs)
+    os.environ["HEAT_TPU_PROCESS_ID"] = str(pid)
+    # a generous peer budget: this test must never trip a peer-failed abort
+    # because one rank deliberately lags its beat
+    os.environ["HEAT_TPU_PEER_TIMEOUT_S"] = "120"
+
+    import heat_tpu as ht
+    import jax
+    from heat_tpu.core import ops, profiler, supervision, telemetry
+
+    assert jax.process_count() == nprocs
+    assert supervision.armed(), "supervision must auto-arm on a multi-process job"
+    # the two halves of the beat-file contract are pinned together
+    assert telemetry.OPS_BEAT_PREFIX == ops.BEAT_PREFIX
+
+    tenant = f"t{pid}"
+    profiler.enable()
+    ops.arm(start_thread=False)  # ticks driven below, deterministically
+    ops.set_slo(tenant, p99_ms=60_000.0)  # healthy: nothing here takes 60 s
+
+    with profiler.request(tenant):
+        # host-side construction only: this container's CPU backend cannot
+        # run multiprocess XLA computations (tests/_mp_ckpt_worker.py)
+        ht.array([float(pid)] * 4 * nprocs, split=0)
+    time.sleep(0.02)
+    sample = ops.sample_once()
+    assert sample is not None, "armed baseline must make the first tick a sample"
+    assert tenant in sample["tenants"], sample["tenants"]
+    assert sample["tenants"][tenant]["count"] >= 1, sample["tenants"][tenant]
+
+    mon = supervision.current_monitor()
+    assert mon is not None, "armed supervision must expose its monitor"
+
+    if pid == nprocs - 1:
+        # the mid-drain stand-in: this rank's beat arrives LATE; nobody may
+        # block on it
+        time.sleep(2.0)
+    else:
+        # the non-blocking proof, taken while the laggard has NOT published
+        # its explicit beat: one KV directory sweep, bounded wall-clock
+        t0 = time.monotonic()
+        early = ops.cluster_snapshot()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, f"cluster_snapshot took {elapsed:.1f}s"
+        assert isinstance(early["ranks"], dict)
+
+    ops.publish_beat(mon.coordinator, mon.ns, pid)
+
+    # fold until every rank's beat is visible (bounded: a dead rank would
+    # simply never appear and this would fail the deadline, not hang)
+    deadline = time.monotonic() + 120.0
+    while True:
+        snap = ops.cluster_snapshot()
+        if len(snap["ranks"]) == nprocs:
+            break
+        assert time.monotonic() < deadline, (
+            f"only {sorted(snap['ranks'])} of {nprocs} beats visible")
+        time.sleep(0.1)
+
+    assert sorted(snap["ranks"]) == [str(r) for r in range(nprocs)]
+    for rank, beat in snap["ranks"].items():
+        assert beat["schema"] == ops.BEAT_SCHEMA, beat
+        assert str(beat["rank"]) == rank, beat
+        assert beat["seq"] >= 1, beat
+    own = snap["ranks"][str(pid)]
+    assert tenant in own["tenants"], own
+    assert own["tenants"][tenant]["count"] >= 1, own
+
+    # --- the file-mode surface: beat files + the public `top` CLI ----------
+    beats_dir = os.path.join(tmpdir, "beats")
+    ops.write_beat_file(beats_dir, rank=pid)
+
+    if pid == 0:
+        deadline = time.monotonic() + 60.0
+        while len(telemetry.load_ops_beats(beats_dir)) < nprocs:
+            assert time.monotonic() < deadline, os.listdir(beats_dir)
+            time.sleep(0.1)
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = telemetry.main(["top", "--dir", beats_dir])
+        out = buf.getvalue()
+        assert rc == 0, out
+        assert "RANK" in out and "RPS" in out, out
+        rows = [ln for ln in out.splitlines()
+                if ln.strip() and ln.strip().split()[0].isdigit()]
+        assert len(rows) == nprocs, out
+
+    print(f"OPS_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,9 @@ _CKPT_WORKER = os.path.join(
 _SUPERVISION_WORKER = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "_mp_supervision_worker.py"
 )
+_OPS_WORKER = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "_mp_ops_worker.py"
+)
 
 
 def _free_port() -> int:
@@ -131,6 +134,38 @@ def test_multiprocess_supervision(nprocs, devices_per_proc, tmp_path):
                 f"survivor {i} incomplete:\n{out[-4000:]}"
             )
             assert "TYPED PeerFailed rank=" + str(nprocs - 1) in out
+
+
+@pytest.mark.parametrize("nprocs,devices_per_proc", [(2, 2), (4, 1)])
+def test_multiprocess_ops_cluster_beats(nprocs, devices_per_proc, tmp_path):
+    """ISSUE 18, the cluster-beat proof: every rank of an N-process job
+    publishes its ops beat on the real coordination KV channel,
+    ``cluster_snapshot`` folds all N with one non-blocking sweep (the last
+    rank publishes late — the mid-drain stand-in — and nobody waits on it),
+    and the beat FILES render one table row per rank through the public
+    ``telemetry top --dir`` CLI (asserted in-worker by rank 0 and re-checked
+    here in the parent)."""
+    outs = _launch(nprocs, devices_per_proc, str(tmp_path), worker=_OPS_WORKER)
+    for i, (rc, out) in enumerate(outs):
+        assert rc == 0, f"worker {i} failed (rc={rc}):\n{out[-4000:]}"
+        assert f"OPS_OK {i}" in out, f"worker {i} incomplete:\n{out[-4000:]}"
+
+    from heat_tpu.core import telemetry
+
+    beats_dir = os.path.join(str(tmp_path), "beats")
+    beats = telemetry.load_ops_beats(beats_dir)
+    assert sorted(beats) == [str(r) for r in range(nprocs)]
+    for rank, beat in beats.items():
+        assert beat["schema"] == "heat-tpu-ops-beat/1"
+        assert str(beat["rank"]) == rank
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = telemetry.main(["top", "--dir", beats_dir])
+    out = buf.getvalue()
+    assert rc == 0, out
+    rows = [ln for ln in out.splitlines()
+            if ln.strip() and ln.strip().split()[0].isdigit()]
+    assert len(rows) == nprocs, out
 
 
 @pytest.mark.parametrize("nprocs,devices_per_proc", [(2, 2), (4, 1)])
