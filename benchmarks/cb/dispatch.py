@@ -81,6 +81,9 @@ def _bootstrap(devices: int) -> None:
         "HEAT_TPU_BATCH_WINDOW_US",  # default scheduler shape
         "HEAT_TPU_EXEC_CACHE",     # artifact loads would mislabel compile_s
         "HEAT_TPU_COMPILE_CACHE",
+        "HEAT_TPU_FORENSICS",      # per-request lifecycle records would tax
+        "HEAT_TPU_FORENSICS_RING",   # the measured dispatch path
+        "HEAT_TPU_FORENSICS_EXEMPLARS",
     ):
         env.pop(knob, None)
     flags = [

@@ -90,6 +90,9 @@ def _bootstrap(devices: int) -> None:
         "HEAT_TPU_COMPILE_CACHE",
         "HEAT_TPU_RESULT_CACHE",
         "HEAT_TPU_RESULT_CACHE_BYTES",
+        "HEAT_TPU_FORENSICS",  # the baseline measures the forensics-OFF path
+        "HEAT_TPU_FORENSICS_RING",
+        "HEAT_TPU_FORENSICS_EXEMPLARS",
     ):
         env.pop(knob, None)
     flags = [
@@ -370,6 +373,7 @@ def run(
     diag_out: str = None,
     telemetry_out: str = None,
     open_rps: dict = None,
+    forensics: bool = False,
     emit=print,
 ):
     """Run the suite; returns ``(records, failed)`` — one record per
@@ -384,6 +388,7 @@ def run(
     import jax
 
     from heat_tpu.core import diagnostics, profiler, telemetry
+    from heat_tpu.core import forensics as _forensics
     from benchmarks.serving.workloads import build_workloads
 
     ndev = len(jax.devices())
@@ -400,6 +405,12 @@ def run(
     was_collecting = telemetry.collecting()
     if telemetry_out:
         telemetry.enable()  # the shard should carry collective windows too
+    # the bootstrap scrubs HEAT_TPU_FORENSICS from the re-exec env (baselines
+    # measure the forensics-OFF path), so arming the request-forensics plane
+    # for a run is an explicit flag, never ambient
+    was_armed = _forensics.armed()
+    if forensics:
+        _forensics.arm()
     records, failed = [], False
 
     def suffixed(pick, mode):
@@ -493,6 +504,8 @@ def run(
             profiler.disable()
         if telemetry_out and not was_collecting:
             telemetry.disable()
+        if forensics and not was_armed:
+            _forensics.disarm()
     return records, failed
 
 
@@ -523,6 +536,12 @@ if __name__ == "__main__":
     parser.add_argument("--telemetry-out",
                         help="directory for this run's ht.telemetry shard "
                         "(mergeable via `python -m heat_tpu.telemetry merge`)")
+    parser.add_argument("--forensics", action="store_true",
+                        help="arm the request-forensics plane for this run "
+                        "(the bootstrap scrubs HEAT_TPU_FORENSICS from the "
+                        "re-exec env, so the opt-in is this flag); exemplars "
+                        "ride the --telemetry-out shard and `python -m "
+                        "heat_tpu.telemetry slow` renders them")
     args = parser.parse_args()
     _bootstrap(args.devices)
     baseline = None
@@ -540,6 +559,7 @@ if __name__ == "__main__":
         trace_out=args.trace_out,
         diag_out=args.diag_out,
         telemetry_out=args.telemetry_out,
+        forensics=args.forensics,
     )
     if args.check and failed:
         sys.exit(1)

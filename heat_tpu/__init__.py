@@ -25,6 +25,7 @@ _jax.config.update("jax_enable_x64", True)
 from .core import *
 from .core import __version__
 from .core import diagnostics
+from .core import forensics
 from .core import ops
 from .core import profiler
 from .core import resilience

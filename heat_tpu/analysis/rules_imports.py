@@ -29,6 +29,7 @@ STDLIB_ONLY: Set[str] = {
     "heat_tpu.core.telemetry",  # merge must run in jax-free tooling
     "heat_tpu.core.supervision",  # _scheduler imports it; jax only lazily
     "heat_tpu.core.ops",  # exporter/parser must run jax-free; executor lazily
+    "heat_tpu.core.forensics",  # record store reads shards jax-free too
     "heat_tpu.analysis",  # the checker polices itself: it must stay light
     "_diag_bootstrap",
 }

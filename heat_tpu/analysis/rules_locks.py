@@ -161,6 +161,18 @@ LOCK_POLICY: Dict[str, ModulePolicy] = {
         }},
         relaxed={"_armed", "_knobs"},
     ),
+    # forensics.py (ISSUE 19) "Thread-safety" section: the live-record table,
+    # finished ring, per-tenant exemplar reservoirs and cost meters all
+    # mutate under the (strictly leaf) module _lock; _enabled is the relaxed
+    # producer gate read bare on every hot path, _knobs the memoised
+    # env-knob cell like the executor's.
+    "heat_tpu.core.forensics": ModulePolicy(
+        locks={"_lock": {
+            "_live", "_ring", "_reservoirs", "_meters", "_finished",
+            "_dropped",
+        }},
+        relaxed={"_enabled", "_knobs"},
+    ),
     # _compile_cache.py (ISSUE 15): the memoised cache-dir knob, the lazy
     # in-memory index, and the applied jax-cache marker mutate under the
     # (strictly leaf) module _lock; reload() is the documented re-read point.

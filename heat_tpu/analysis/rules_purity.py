@@ -32,13 +32,18 @@ from typing import List
 
 from .engine import Finding, ModuleIndex, Universe, dotted_chain
 
-TELEMETRY_MODULES = {"diagnostics", "profiler", "telemetry"}
+TELEMETRY_MODULES = {"diagnostics", "profiler", "telemetry", "forensics"}
 TELEMETRY_CALLS = {
     "counter", "span", "observe", "scope",
     "record_collective", "record_compile", "record_dispatch_event",
     "record_fallback", "record_resilience_event", "record_pad_waste",
     "record_backend_event", "record_counter", "record_force_memory",
     "collective_window", "flight_record",
+    # forensics producers (ISSUE 19): same gate discipline — every call
+    # inside a traced body sits under `if forensics._enabled:`
+    "note_admission", "note_scheduled", "note_program", "note_batch_execute",
+    "note_result_cache", "note_compile_cache", "note_collective",
+    "note_event", "collective_timer",
 }
 GATE_ATTRS = {"_enabled", "_tracing", "_active", "_armed", "_collecting"}
 GATE_CALLS = {"enabled", "tracing", "executor_enabled"}

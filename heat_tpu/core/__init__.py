@@ -2,8 +2,10 @@
 
 from . import diagnostics
 from . import profiler
+from . import forensics
 from . import resilience
 from . import telemetry
+from .forensics import explain
 from .communication import *
 from ._executor import (
     executor_stats,

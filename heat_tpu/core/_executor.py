@@ -134,8 +134,8 @@ import jax
 import jax.numpy as jnp
 
 from . import (
-    _compile_cache, _result_cache, _scheduler, diagnostics, ops, profiler,
-    resilience, supervision,
+    _compile_cache, _result_cache, _scheduler, diagnostics, forensics, ops,
+    profiler, resilience, supervision,
 )
 from ._compile_cache import executor_save_warmup, executor_warmup
 from ._scheduler import PendingValue
@@ -376,12 +376,15 @@ def reload_env_knobs() -> None:
     :func:`rebuild_scheduler`. The result-memoization knobs
     (``HEAT_TPU_RESULT_CACHE`` / ``HEAT_TPU_RESULT_CACHE_BYTES``) re-read
     here as well — see :mod:`._result_cache`. The live-operations knobs
-    (``HEAT_TPU_OPS*``) re-read here too — see :mod:`.ops`."""
+    (``HEAT_TPU_OPS*``) re-read here too — see :mod:`.ops` — as do the
+    request-forensics knobs (``HEAT_TPU_FORENSICS*``) — see
+    :mod:`.forensics`."""
     _knobs.reload()
     supervision.reload_env_knobs()
     _compile_cache.reload()
     _result_cache.reload()
     ops.reload()
+    forensics.reload()
 
 
 def jit_threshold() -> int:
@@ -792,6 +795,9 @@ def executor_stats(top: int = 0) -> dict:
     stats["cache_misses"] = rc["misses"]
     stats["cache_bytes_saved"] = rc["bytes_saved"]
     stats["cache_invalidations"] = rc["invalidations"]
+    # per-tenant cost meters (forensics plane): empty dict until armed; the
+    # fold over tenants reconciles exactly with forensics.totals()
+    stats["tenant_cost"] = forensics.tenant_cost()
     with _lock:
         stats["quarantined"] = dict(_quarantined)
     if top > 0:
@@ -1009,7 +1015,7 @@ class _Program:
         "body", "out_shardings", "donate_index", "meta",
         "label", "hits", "compile_s", "arg_specs", "_plain", "_donating",
         "_variants", "_batched", "failures", "proven", "ewma_s",
-        "spec", "fingerprint", "aot_loaded",
+        "spec", "fingerprint", "aot_loaded", "flops",
     )
 
     def __init__(self, body, out_shardings, donate_index, meta):
@@ -1036,6 +1042,9 @@ class _Program:
         self.spec = None
         self.fingerprint = None
         self.aot_loaded = False
+        # per-signature FLOPs estimate (XLA cost analysis), memoised by
+        # _program_flops while the forensics plane is armed; None = unknown
+        self.flops = None
         # Service-time EWMA over REPLAY dispatches (first calls are compile
         # time, not service time), the estimate behind HEAT_TPU_SHED admission
         # control. It measures host-side DISPATCH wall time — jax calls return
@@ -1081,15 +1090,21 @@ class _Program:
             return
         now = time.monotonic()
         if now >= dl:
+            if forensics._enabled:
+                forensics.note_admission("staged", "deadline-expired", dl - now)
             raise resilience.DeadlineExceeded(
                 f"deadline passed before dispatch ({self.label or 'program'})"
             )
         if _knobs.shed and self.ewma_s > 0.0 and now + self.ewma_s >= dl:
+            if forensics._enabled:
+                forensics.note_admission("staged", "shed", dl - now)
             raise resilience.Shed(
                 f"admission control: estimated service time "
                 f"{self.ewma_s * 1e3:.2f} ms exceeds the remaining deadline "
                 f"budget ({self.label or 'program'})"
             )
+        if forensics._enabled:
+            forensics.note_admission("staged", "admitted", dl - now)
 
     def __call__(self, *args, donate: bool = False, donate_leaves: Tuple[int, ...] = ()):
         if profiler._deadline_seen:
@@ -1113,11 +1128,27 @@ class _Program:
             # (fingerprint, input digest) — a validated hit IS the execution.
             # Donation-bearing variants never consult or fill (their inputs
             # die in the call); expired deadlines raised above, before this.
-            rkey = _result_key(self, args)
+            rkey, rwhy = _result_key_explained(self, args)
             if rkey is not None:
                 cached = _result_cache.lookup(rkey, _tenant_or_none())
                 if cached is not _result_cache.MISS:
+                    if forensics._enabled:
+                        forensics.note_result_cache(
+                            "hit", nbytes=_result_cache.result_nbytes(cached)
+                        )
                     return cached
+                if forensics._enabled:
+                    forensics.note_result_cache("miss")
+            elif forensics._enabled:
+                # the *reason* the consult was skipped is forensic signal: a
+                # tenant whose tail is all bypasses is paying for rng labels
+                # or undigestable operands, not for cold caches
+                forensics.note_result_cache("bypass", rwhy)
+        elif forensics._enabled:
+            forensics.note_result_cache(
+                "bypass",
+                "cache-off" if not _result_cache._enabled else "donation",
+            )
         if donate_leaves:
             variants = self._variants
             if (
@@ -1227,8 +1258,17 @@ class _Program:
             self.compile_s += dt
             if diagnostics._enabled:
                 diagnostics.record_compile(self.label or "program", dt)
+            if forensics._enabled:
+                forensics.note_program(self.label or "program", dt, "compile")
+                forensics.note_compile_cache(
+                    "aot-load" if self.aot_loaded
+                    else ("miss" if _compile_cache.armed() else "off")
+                )
         else:
             self._note_service(dt)
+            if forensics._enabled:
+                forensics.note_program(self.label or "program", dt, "execute",
+                                       flops=_program_flops(self))
         self.proven = True
         if rkey is not None:
             # memoised only after a SUCCESSFUL plain-path execution; the
@@ -1328,26 +1368,63 @@ class _Program:
         return out
 
 
-def _result_key(prog: "_Program", args) -> Optional[Tuple[str, Tuple]]:
+def _result_key_explained(
+    prog: "_Program", args
+) -> Tuple[Optional[Tuple[str, Tuple]], Optional[str]]:
     """The result-cache key ``(fingerprint, input digest)`` for a plain call
-    of ``prog`` over ``args``, or None when the call is uncacheable: no
-    replay spec (warmup gap / out=-aliasing signature), an RNG-consuming
-    label, or any operand without a digest (large unregistered arrays,
-    pending async values) — see ``_result_cache`` for the documented bypass
-    contract.  The fingerprint is the compile cache's (sha256 of the
-    canonical replay spec), memoised on the program."""
+    of ``prog`` over ``args``, or ``(None, reason)`` when the call is
+    uncacheable: ``no-replay-spec`` (warmup gap / out=-aliasing signature),
+    ``rng-label`` (an RNG-consuming label), or ``undigestable-operand``
+    (large unregistered arrays, pending async values) — see ``_result_cache``
+    for the documented bypass contract. The reason string is the forensic
+    record's bypass label.  The fingerprint is the compile cache's (sha256 of
+    the canonical replay spec), memoised on the program."""
     spec = prog.spec
     if spec is None:
-        return None
+        return None, "no-replay-spec"
     if _result_cache.uncacheable_label(prog.label):
-        return None
+        return None, "rng-label"
     digest = _result_cache.digest_args(args)
     if digest is None:
-        return None
+        return None, "undigestable-operand"
     fp = prog.fingerprint
     if fp is None:
         fp = prog.fingerprint = _compile_cache.fingerprint(spec)
-    return (fp, digest)
+    return (fp, digest), None
+
+
+def _result_key(prog: "_Program", args) -> Optional[Tuple[str, Tuple]]:
+    """See :func:`_result_key_explained` (this is its key half — callers that
+    do not record bypass reasons)."""
+    return _result_key_explained(prog, args)[0]
+
+
+def _program_flops(prog: "_Program") -> float:
+    """Per-signature FLOPs estimate from XLA's compiled cost analysis,
+    memoised on the program — computed at most once per signature, and only
+    reached while the forensics plane is armed (the cost-metering feed).
+    Returns 0.0 (memoised) when the executable cannot be re-lowered or the
+    backend offers no cost model; 0.0 un-memoised when the plain variant or
+    arg specs have not materialised yet (a later call may fill them)."""
+    flops = prog.flops
+    if flops is not None:
+        return flops
+    if prog._plain is None or prog.arg_specs is None:
+        return 0.0
+    try:
+        cost = prog._plain.lower(*prog.arg_specs).compile().cost_analysis()
+    except Exception as exc:
+        diagnostics.record_fallback(
+            "executor.cost_analysis",
+            f"{type(exc).__name__}: {prog.label or 'program'}",
+        )
+        prog.flops = 0.0
+        return 0.0
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    flops = float(cost.get("flops", 0.0)) if isinstance(cost, dict) else 0.0
+    prog.flops = flops
+    return flops
 
 
 def lookup(key, build: Callable[[], Any], label: Optional[str] = None,
@@ -1472,6 +1549,11 @@ def fallback_after_failure(key, prog: "_Program", exc: BaseException,
             # counted exactly once at the shard that pulled it; everything
             # else (the in-call _lifecycle_check raises) is counted here
             _get_scheduler().note_lifecycle(kind, _tenant_or_none())
+            if forensics._enabled:
+                forensics.note_event(
+                    "typed-failure",
+                    f"{kind}: {prog.label or _key_label(key)}",
+                )
         return False
     if isinstance(exc, (resilience.PeerFailed, resilience.CollectiveTimeout)):
         # a supervision abort delivered into a queued execution: typed
@@ -1505,6 +1587,11 @@ def fallback_after_failure(key, prog: "_Program", exc: BaseException,
     if diagnostics._enabled:
         diagnostics.record_fallback(
             f"executor.{phase}", f"{label}: {type(exc).__name__}: {exc}"
+        )
+    if forensics._enabled:
+        # the caller re-runs the op eagerly: the record's eager-replay leg
+        forensics.note_event(
+            "eager-replay", f"{label}: {type(exc).__name__}"
         )
     return True
 
@@ -1790,6 +1877,10 @@ def defer_node(operation, fn_kwargs, operands, gshape, split, comm):
                 _get_scheduler().note_lifecycle(
                     "deadline_expired", _tenant_or_none()
                 )
+                if forensics._enabled:
+                    forensics.note_admission(
+                        "defer", "deadline-expired", dl - time.monotonic()
+                    )
                 raise resilience.DeadlineExceeded(
                     f"deadline passed before defer of "
                     f"{_op_label(operation)}"
@@ -1874,8 +1965,11 @@ def _roots_deadline(roots) -> Optional[float]:
 
 def _tenant_or_none() -> Optional[str]:
     """The ambient request tag for lifecycle accounting, or None outside a
-    request scope (per-tenant attribution is best-effort telemetry)."""
-    return profiler.current_request_tag() if profiler._active else None
+    request scope (per-tenant attribution is best-effort telemetry). Flows
+    while either the profiler or the forensics plane is on — forensic
+    records thread the same request contextvar."""
+    return (profiler.current_request_tag()
+            if profiler.attribution_active() else None)
 
 
 def _force_graph_inner(roots: Tuple[Deferred, ...]) -> bool:
@@ -1892,20 +1986,29 @@ def _force_graph_inner(roots: Tuple[Deferred, ...]) -> bool:
             _get_scheduler().note_lifecycle("shed", _tenant_or_none())
             raise abort
     deadline = _roots_deadline(roots)
-    if deadline is not None and time.monotonic() >= deadline:
-        # admission checkpoint: the deadline has already passed, so planning,
-        # compiling, or dispatching would be pure waste — the reader gets the
-        # typed error NOW and the nodes stay unforced. The rejection CONSUMES
-        # the roots' captured deadlines (the request that owned them has been
-        # told): the data itself is not poisoned, so a later force outside
-        # the expired scope computes these same nodes normally.
-        for r in roots:
-            r.deadline = None
-        _get_scheduler().note_lifecycle("deadline_expired", _tenant_or_none())
-        raise resilience.DeadlineExceeded(
-            f"deadline passed before force admission "
-            f"({_op_label(roots[0].operation)})"
-        )
+    if deadline is not None:
+        now = time.monotonic()
+        if now >= deadline:
+            # admission checkpoint: the deadline has already passed, so
+            # planning, compiling, or dispatching would be pure waste — the
+            # reader gets the typed error NOW and the nodes stay unforced.
+            # The rejection CONSUMES the roots' captured deadlines (the
+            # request that owned them has been told): the data itself is not
+            # poisoned, so a later force outside the expired scope computes
+            # these same nodes normally.
+            for r in roots:
+                r.deadline = None
+            _get_scheduler().note_lifecycle("deadline_expired", _tenant_or_none())
+            if forensics._enabled:
+                forensics.note_admission(
+                    "force", "deadline-expired", deadline - now
+                )
+            raise resilience.DeadlineExceeded(
+                f"deadline passed before force admission "
+                f"({_op_label(roots[0].operation)})"
+            )
+        if forensics._enabled:
+            forensics.note_admission("force", "admitted", deadline - now)
     if async_dispatch_enabled():
         return _force_async(roots, deadline)
     # serialized legacy path: settle any dispatch-done futures an earlier
@@ -2531,7 +2634,8 @@ def _force_async(roots: Tuple[Deferred, ...],
             pendings.append(p)
             for node in pl.entry_nodes[i]:
                 node.value = p
-        req = profiler.current_request() if profiler._active else None
+        req = (profiler.current_request()
+               if profiler.attribution_active() else None)
 
     # ---- lock released: everything below runs concurrently with other plans
     # tenant for lifecycle-ledger attribution, resolved eagerly only when a
@@ -2733,8 +2837,16 @@ def _execute_batch(items) -> None:
     try:
         flat = [it.leaves[j] for it in items for j in array_pos]
         scalars = [base[j] for j in scalar_pos]
+        t0 = time.perf_counter() if forensics._enabled else 0.0
         with profiler.attributed(items[0].req):
             out_flat = prog.call_batched(width, array_pos, scalar_pos, flat, scalars)
+        if forensics._enabled:
+            # width-share cost fold: each of the width requests is billed
+            # dt/width device seconds plus its own single program's FLOPs
+            forensics.note_batch_execute(
+                [it.req for it in items], prog.label or "program",
+                time.perf_counter() - t0, flops_each=_program_flops(prog),
+            )
         n_outs = len(out_flat) // width
         if diagnostics._enabled:
             diagnostics.counter("executor.batched_requests", width)
@@ -2815,8 +2927,15 @@ def call_staged(key, prog: _Program, x):
         if rkey is not None:
             cached = _result_cache.lookup(rkey, tenant, count_miss=False)
             if cached is not _result_cache.MISS:
+                if forensics._enabled:
+                    forensics.note_result_cache(
+                        "hit", nbytes=_result_cache.result_nbytes(cached)
+                    )
                 return cached
-    req = profiler.current_request() if profiler._active else None
+            # no miss note here: this pre-queue consult is an optimisation
+            # (count_miss=False) — the real consult inside prog() records it
+    req = (profiler.current_request()
+           if profiler.attribution_active() else None)
     pending = PendingValue(x.shape, x.dtype)
 
     def fail(exc: BaseException) -> None:

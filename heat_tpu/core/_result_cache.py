@@ -308,6 +308,17 @@ def _leaves_of(value) -> Optional[Tuple]:
     return tuple(leaves)
 
 
+def result_nbytes(value) -> int:
+    """Total buffer bytes of a cached result value (the same leaf fold
+    :func:`store` records as the entry's ``nbytes``). The executor's
+    forensics hooks use this to credit a hit's bytes-saved to the serving
+    tenant's cost meter without re-entering any shard lock."""
+    leaves = _leaves_of(value)
+    if leaves is None:
+        return 0
+    return sum(int(leaf.nbytes) for leaf in leaves)
+
+
 def _entry_corrupt(entry: _Entry) -> Optional[str]:
     """Structural re-check at hit time: None when sound, else the rejection
     detail.  Catches poisoned entries (recorded avals no longer match the

@@ -132,8 +132,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 try:  # standalone file-path load (driver entry points): no parent package —
     from . import resilience  # the lifecycle verbs are never used in that mode
     from . import supervision  # sentinel checkpoint; stdlib-only like us
+    from . import forensics  # request lifecycle records; stdlib-only like us
 except ImportError:  # pragma: no cover - exercised via tests/test_analysis.py
-    resilience = supervision = None
+    resilience = supervision = forensics = None
 
 __all__ = ["PendingValue", "WorkItem", "DispatchScheduler"]
 
@@ -205,7 +206,8 @@ class WorkItem:
 
     __slots__ = (
         "seq", "tenant", "req", "execute", "batch_key", "prog", "leaves",
-        "complete", "fail", "deadline",
+        "complete", "fail", "deadline", "t_submit", "t_popped", "hold_s",
+        "stolen_from",
     )
 
     def __init__(self, tenant: str, execute: Callable[[], None], *,
@@ -223,6 +225,14 @@ class WorkItem:
         # absolute wall-clock deadline (time.monotonic() instant) or None:
         # the scheduler cancels rather than executes an item past it
         self.deadline = deadline
+        # forensics timeline stamps (time.monotonic()): enqueue instant
+        # (always stamped — the submit path already holds the clock value),
+        # dequeue instant (stamped only while forensics is armed), leader's
+        # batch-window hold, and the shard index the item was stolen from
+        self.t_submit: Optional[float] = None
+        self.t_popped: Optional[float] = None
+        self.hold_s = 0.0
+        self.stolen_from: Optional[int] = None
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -344,6 +354,7 @@ class _Shard:
             if self._depth > self.queue_depth_peak:
                 self.queue_depth_peak = self._depth
             now = time.monotonic()
+            item.t_submit = now
             last = self._last_submit
             self._last_submit = now
             if last is not None:
@@ -406,6 +417,8 @@ class _Shard:
                     del self._queues[tenant]
                 self._unindex_locked(item)
                 self._depth -= 1
+                if forensics is not None and forensics._enabled:
+                    item.t_popped = time.monotonic()
                 return item
         return None
 
@@ -449,7 +462,9 @@ class _Shard:
             if len(self._by_key.get(key, ())) + 1 >= batch_cap:
                 break  # the batch is full: no reason to keep holding
             self._cv.wait(hold_until - now)
-        self.window_hold_ns += int((time.monotonic() - t0) * 1e9)
+        held = time.monotonic() - t0
+        item.hold_s = held
+        self.window_hold_ns += int(held * 1e9)
         if len(self._by_key.get(key, ())) > before:
             self.window_widened += 1
 
@@ -605,6 +620,10 @@ class _Shard:
                     need -= len(live)
                     if other is not self:
                         stolen += len(live)
+                        for w in live:
+                            w.stolen_from = other.index
+                            if w.t_popped is None:
+                                w.t_popped = now
                     for w in exp:
                         sched._deliver_lifecycle(
                             w, "deadline_expired",
@@ -621,6 +640,21 @@ class _Shard:
                     self.batched_requests += width
                     self.batch_width_hist[width] = (
                         self.batch_width_hist.get(width, 0) + 1
+                    )
+            if forensics is not None and forensics._enabled:
+                # lifecycle records: queue wait / window hold / shard / width
+                # / steal provenance per item — OUTSIDE self._cv (forensics'
+                # lock is a strict leaf; no scheduler lock is held here)
+                t_sched = time.monotonic()
+                width = len(group)
+                for w in group:
+                    if w.req is None:
+                        continue
+                    tp = w.t_popped if w.t_popped is not None else t_sched
+                    qw = (max(0.0, tp - w.t_submit)
+                          if w.t_submit is not None else 0.0)
+                    forensics.note_scheduled(
+                        w.req, self.index, qw, w.hold_s, width, w.stolen_from
                     )
             if supervision is not None and supervision._armed:
                 # the scheduler's supervision checkpoint: once the abort
@@ -861,6 +895,10 @@ class DispatchScheduler:
             diagnostics.counter(f"executor.{kind}", 1)
         if profiler._active:
             profiler.record_counter(f"lifecycle.{kind}", self._lifecycle_total(kind))
+        if forensics is not None and forensics._enabled and item.req is not None:
+            forensics.note_event(
+                "typed-failure", f"{kind}: {item.describe()}", rid=item.req
+            )
         telemetry.flight_record(
             "lifecycle", f"scheduler.{kind}", item.describe(), kind=kind,
         )

@@ -36,7 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from . import diagnostics, profiler, resilience, supervision, telemetry
+from . import diagnostics, forensics, profiler, resilience, supervision, telemetry
 
 
 def _guarded(site, fn, *args, **kwargs):
@@ -71,6 +71,17 @@ def _guarded(site, fn, *args, **kwargs):
 def _guarded_telemetry(site, fn, *args, **kwargs):
     if telemetry._collecting:
         with telemetry.collective_window(site):
+            return _guarded_forensics(site, fn, *args, **kwargs)
+    return _guarded_forensics(site, fn, *args, **kwargs)
+
+
+def _guarded_forensics(site, fn, *args, **kwargs):
+    # request-forensics leg: time the whole invocation (retries included)
+    # onto the ambient request's lifecycle record. Auxiliary timing only —
+    # collectives run at trace time, nested inside the compile stage, so the
+    # reducer reports this beside the stages rather than summing it.
+    if forensics._enabled:
+        with forensics.collective_timer(site):
             return _guarded_run(site, fn, *args, **kwargs)
     return _guarded_run(site, fn, *args, **kwargs)
 
@@ -444,29 +455,36 @@ class MeshCommunication(Communication):
             return self.size
 
     def _record_collective(self, op: str, axis_name, x) -> None:
-        """Report one collective to ht.diagnostics: logical bytes = per-participant
-        payload × participants. Callers gate on ``diagnostics._enabled`` so the
-        disabled cost is one attribute read."""
+        """Report one collective's logical bytes (= per-participant payload ×
+        participants) to ht.diagnostics and/or the forensics cost meters —
+        each consumer gated on its own switch here. Callers gate on
+        ``diagnostics._enabled or forensics._enabled`` so the disabled cost
+        stays one attribute read per plane."""
         participants = self._axis_participants(axis_name)
-        diagnostics.record_collective(  # ht: ignore[trace-telemetry-unguarded] -- every caller gates on diagnostics._enabled (this helper's docstring contract); record_collective additionally self-gates
-            op, axis_name or self.axis_name, participants,
-            _payload_bytes(x) * participants,
-        )
+        nbytes = _payload_bytes(x) * participants
+        if diagnostics._enabled:
+            diagnostics.record_collective(
+                op, axis_name or self.axis_name, participants, nbytes,
+            )
+        if forensics._enabled:
+            # bytes only: the invocation's wall time is recorded by the
+            # _guarded_forensics leg around the actual dispatch
+            forensics.note_collective(op, 0.0, nbytes=nbytes)
 
     def psum(self, x, axis_name: Optional[str] = None):
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("psum", axis_name, x)
         return _guarded("comm.psum", jax.lax.psum, x, axis_name or self.axis_name)
 
     Allreduce = psum
 
     def pmax(self, x, axis_name: Optional[str] = None):
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("pmax", axis_name, x)
         return _guarded("comm.pmax", jax.lax.pmax, x, axis_name or self.axis_name)
 
     def pmin(self, x, axis_name: Optional[str] = None):
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("pmin", axis_name, x)
         return _guarded("comm.pmin", jax.lax.pmin, x, axis_name or self.axis_name)
 
@@ -474,7 +492,7 @@ class MeshCommunication(Communication):
         """Allgather along array axis ``axis`` (reference ``__allgather_like``
         ``communication.py:1047-1128``; the axis-permutation machinery there is subsumed
         by ``jax.lax.all_gather(axis=...)``)."""
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("all_gather", axis_name, x)
         return _guarded(
             "comm.all_gather", jax.lax.all_gather,
@@ -485,7 +503,7 @@ class MeshCommunication(Communication):
 
     def all_to_all(self, x, split_axis: int, concat_axis: int, axis_name: Optional[str] = None):
         """Alltoall (reference ``__alltoall_like`` ``communication.py:1236``)."""
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("all_to_all", axis_name, x)
         return _guarded(
             "comm.all_to_all", jax.lax.all_to_all,
@@ -497,7 +515,7 @@ class MeshCommunication(Communication):
 
     def ppermute(self, x, perm, axis_name: Optional[str] = None):
         """Point-to-point send/recv pattern (reference Send/Recv ``communication.py:541-707``)."""
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("ppermute", axis_name, x)
         return _guarded(
             "comm.ppermute", jax.lax.ppermute,
@@ -507,7 +525,7 @@ class MeshCommunication(Communication):
     def ring_shift(self, x, shift: int = 1, axis_name: Optional[str] = None):
         """Rotate shards around the ring — the TPU form of the reference's ring algorithms
         (``spatial/distance.py:209``)."""
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("ring_shift", axis_name, x)
         n = self.size
         perm = [(i, (i + shift) % n) for i in range(n)]
@@ -525,7 +543,7 @@ class MeshCommunication(Communication):
         latency win at pod scale.) Multi-axis communicators keep the psum form,
         whose all-axis reduction is what their semantics need.
         """
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("broadcast", axis_name, x)
         return _guarded("comm.broadcast", self._broadcast_impl, x, root, axis_name)
 
@@ -562,7 +580,7 @@ class MeshCommunication(Communication):
         form whose per-device payload is P×. Works for any P (not just powers of
         two); shard 0 receives the additive identity.
         """
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("exscan", axis_name, x)
         return _guarded("comm.exscan", self._exscan_impl, x, axis_name)
 
@@ -587,7 +605,7 @@ class MeshCommunication(Communication):
     def scan(self, x, axis_name: Optional[str] = None):
         """Inclusive prefix-sum over shards (reference Scan ``communication.py:1881``):
         the exclusive scan plus the local contribution."""
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("scan", axis_name, x)
         return self.exscan(x, axis_name) + x
 
@@ -598,7 +616,7 @@ class MeshCommunication(Communication):
         Reduce ``communication.py:1823``): SPMD collectives are symmetric, so this
         is the all-reduce with non-root shards zeroed — the rooted contract without
         a second collective."""
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("reduce", axis_name, x)
         name = axis_name or self.axis_name
         total = _guarded("comm.reduce", jax.lax.psum, x, name)
@@ -611,7 +629,7 @@ class MeshCommunication(Communication):
         """Gather shards to ``root`` (reference Gather ``communication.py:1299``):
         the all-gather with non-root shards zeroed — rooted semantics on a
         symmetric collective."""
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("gather", axis_name, x)
         name = axis_name or self.axis_name
         full = _guarded("comm.gather", jax.lax.all_gather, x, name, axis=axis, tiled=True)
@@ -627,7 +645,7 @@ class MeshCommunication(Communication):
         the wire cost is the broadcast's P−1 full payloads rather than MPI's 1/P
         chunks — acceptable because every framework path that needs 1/P placement
         uses shardings (``comm.shard``), not this rooted op."""
-        if diagnostics._enabled:
+        if diagnostics._enabled or forensics._enabled:
             self._record_collective("scatter", axis_name, x)
         name = axis_name or self.axis_name
         full = self.broadcast(x, root=root, axis_name=name)

@@ -170,11 +170,14 @@ _providers: Dict[str, Callable[[], Any]] = {}
 # ``resilience.atomic_write`` so :func:`dump` commits whole artifacts;
 # ``_resilience_tee`` / ``_fallback_tee`` are ``telemetry.flight_record``
 # adapters so every failure-path event also lands in the flight-recorder ring
-# (and can trigger its automatic post-mortem dump). Tees are invoked OUTSIDE
-# ``_lock`` — the flight ring has its own lock and must stay a leaf.
+# (and can trigger its automatic post-mortem dump); ``_forensics_tee`` is the
+# forensics event adapter so typed failures also land on the active request's
+# critical path. Tees are invoked OUTSIDE ``_lock`` — the flight ring and the
+# forensics store have their own locks and must stay leaves.
 _atomic_writer: Optional[Callable[..., Any]] = None
 _resilience_tee: Optional[Callable[[str, str, str], None]] = None
 _fallback_tee: Optional[Callable[[str, str], None]] = None
+_forensics_tee: Optional[Callable[[str, str, str], None]] = None
 
 
 def _utcnow() -> str:
@@ -338,6 +341,9 @@ def record_resilience_event(site: str, kind: str, detail: str = "") -> None:
     tee = _resilience_tee
     if tee is not None:
         tee(site, kind, rec["detail"])
+    ftee = _forensics_tee
+    if ftee is not None:
+        ftee(site, kind, rec["detail"])
 
 
 def record_pad_waste(gshape, split: int, padded_dim: int) -> None:

@@ -115,10 +115,12 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 try:
-    from . import diagnostics, profiler, resilience, supervision, telemetry
+    from . import (diagnostics, forensics, profiler, resilience, supervision,
+                   telemetry)
 except ImportError:  # standalone file-path load (no parent package): the
     # exporter/parser surface still works; live sampling degrades to None
-    diagnostics = profiler = resilience = supervision = telemetry = None
+    diagnostics = forensics = profiler = resilience = supervision = None
+    telemetry = None
 
 __all__ = [
     "SCHEMA",
@@ -280,6 +282,7 @@ def _collect_cumulative() -> dict:
         "draining": False,
         "pressure": {"per_shard": [], "service_ewma_s": {}},
         "tenant_lifecycle": {},
+        "tenant_cost": {},
         "request_hists": {},
         "breakers": {},
         "supervision": {"armed": False, "aborted": None},
@@ -306,6 +309,10 @@ def _collect_cumulative() -> dict:
         cum["queue_depth"] = sum(
             s.get("queue_depth", 0) for s in cum["pressure"]["per_shard"])
         cum["tenant_lifecycle"] = ex.get("lifecycle_by_tenant", {})
+        # forensics cost meters are CUMULATIVE cells (device seconds, flops,
+        # logical collective bytes, cache bytes saved) — exported as counters,
+        # never folded into the windowed deltas (the merge disjointness rule)
+        cum["tenant_cost"] = ex.get("tenant_cost", {})
     if profiler is not None:
         hists = profiler.histogram_snapshots()
         cum["request_hists"] = {
@@ -402,7 +409,10 @@ def sample_once() -> Optional[dict]:
     only establishes the baseline)."""
     global _prev_cum, _samples_total, _delta_resets
     cum = _collect_cumulative()
-    transitions: List[Tuple[str, str, str]] = []  # (tenant, kind, detail)
+    # (tenant, kind, detail-dict): details stay dicts until after `_lock` is
+    # released so the slo-burn case can attach forensics exemplar refs without
+    # calling into another locking module from under the leaf lock
+    transitions: List[Tuple[str, str, Dict[str, Any]]] = []
     with _lock:
         prev = _prev_cum
         _prev_cum = cum
@@ -423,6 +433,7 @@ def sample_once() -> Optional[dict]:
             "breakers": cum["breakers"],
             "draining": cum["draining"],
             "supervision": cum["supervision"],
+            "tenant_cost": cum.get("tenant_cost", {}),
         }
         try:
             deltas = {}
@@ -465,19 +476,16 @@ def sample_once() -> Optional[dict]:
             if alerting and not state["active"]:
                 state.update(active=True, since=cum["t"])
                 state["transitions"] += 1
-                detail = json.dumps({
+                transitions.append((tenant, "slo-burn", {
                     "tenant": tenant, "burn": burns,
                     "window_s": sample["window_s"],
                     "tenant_window": sample["tenants"].get(tenant),
                     "per_shard": cum["pressure"]["per_shard"],
-                }, sort_keys=True)
-                transitions.append((tenant, "slo-burn", detail))
+                }))
             elif not alerting and state["active"]:
                 state.update(active=False, since=cum["t"])
-                transitions.append((
-                    tenant, "slo-burn-cleared",
-                    json.dumps({"tenant": tenant, "burn": burns},
-                               sort_keys=True)))
+                transitions.append((tenant, "slo-burn-cleared",
+                                    {"tenant": tenant, "burn": burns}))
             slo_out[tenant] = {
                 "objectives": dict(slo),
                 "burn": burns,
@@ -487,8 +495,16 @@ def sample_once() -> Optional[dict]:
         _ring.append(sample)
         _samples_total += 1
     # ---- event emission OUTSIDE the leaf lock (telemetry/diagnostics lock)
-    for tenant, kind, detail in transitions:
+    for tenant, kind, body in transitions:
         site = f"ops.slo.{tenant}"
+        if kind == "slo-burn":
+            # reference the tenant's slowest-K forensic exemplars so the
+            # post-mortem names the concrete requests that burned the budget
+            # (forensics takes its own leaf lock — hence after `_lock`)
+            body["exemplars"] = (
+                forensics.exemplar_refs(tenant, 3)
+                if forensics is not None and forensics._enabled else [])
+        detail = json.dumps(body, sort_keys=True)
         if kind == "slo-burn" and diagnostics is not None:
             # the typed event on the always-on resilience stream; its
             # telemetry tee BOTH lands it on the flight ring and auto-dumps
@@ -695,6 +711,34 @@ def render_openmetrics() -> str:
             alert.add(entry["alert"], tenant=tenant)
         if burn.rows:
             fams.extend((burn, alert))
+        # ---- forensics cost meters: cumulative counters per tenant, plus
+        # the tenant's lifetime stage time-share as a gauge family
+        cost = sample.get("tenant_cost", {})
+        if cost:
+            dev = _Family("ht_tenant_device_seconds", "counter",
+                          "attributed device execute time per tenant")
+            flops = _Family("ht_tenant_flops", "counter",
+                            "attributed device FLOPs per tenant")
+            cbytes = _Family("ht_tenant_collective_bytes", "counter",
+                             "logical collective bytes attributed per tenant")
+            saved = _Family("ht_tenant_cache_bytes_saved", "counter",
+                            "result-cache bytes served per tenant")
+            share = _Family("ht_tenant_stage_share", "gauge",
+                            "fraction of the tenant's request time per stage")
+            for tenant, cell in sorted(cost.items()):
+                dev.add(cell.get("device_seconds", 0.0), tenant=tenant)
+                flops.add(cell.get("flops", 0.0), tenant=tenant)
+                cbytes.add(cell.get("collective_bytes", 0.0), tenant=tenant)
+                saved.add(cell.get("cache_bytes_saved", 0.0), tenant=tenant)
+                stages = cell.get("stage_seconds", {})
+                total = sum(stages.values())
+                if total > 0:
+                    for stage, secs in sorted(stages.items()):
+                        share.add(round(secs / total, 6),
+                                  tenant=tenant, stage=stage)
+            fams.extend((dev, flops, cbytes, saved))
+            if share.rows:
+                fams.append(share)
     lines: List[str] = []
     for fam in fams:
         lines.extend(fam.render())
@@ -896,6 +940,27 @@ def _compact_beat(rank: int) -> dict:
         "t": _utcnow(),
     }
     if sample is not None:
+        # per-tenant rows: the window's latency/lifecycle cells joined with
+        # the CUMULATIVE forensics cost meters (device_s / flops /
+        # collective_bytes) — a tenant seen only by the cost meters (e.g. the
+        # unattributed "-" bucket) still gets a row
+        cost = sample.get("tenant_cost", {})
+        tenants: Dict[str, dict] = {}
+        for tenant in sorted(set(sample.get("tenants", {})) | set(cost)):
+            cell = sample.get("tenants", {}).get(tenant, {})
+            cc = cost.get(tenant, {})
+            tenants[tenant] = {
+                "p99_ms": cell.get("p99_ms"),
+                "count": cell.get("count", 0),
+                "bad": cell.get("bad", 0),
+                "burn_1m": sample.get("slo", {}).get(tenant, {})
+                .get("burn", {}).get("1m"),
+                "alert": sample.get("slo", {}).get(tenant, {})
+                .get("alert", False),
+                "device_s": round(cc.get("device_seconds", 0.0), 6),
+                "flops": cc.get("flops", 0.0),
+                "collective_bytes": cc.get("collective_bytes", 0.0),
+            }
         beat.update({
             "window_s": sample["window_s"],
             "rps": sample["rates"]["rps"],
@@ -903,18 +968,7 @@ def _compact_beat(rank: int) -> dict:
             "cache_hit_rate": sample["rates"]["cache_hit_rate"],
             "queue_depth": sample["queue_depth"],
             "draining": sample["draining"],
-            "tenants": {
-                tenant: {
-                    "p99_ms": cell.get("p99_ms"),
-                    "count": cell.get("count", 0),
-                    "bad": cell.get("bad", 0),
-                    "burn_1m": sample.get("slo", {}).get(tenant, {})
-                    .get("burn", {}).get("1m"),
-                    "alert": sample.get("slo", {}).get(tenant, {})
-                    .get("alert", False),
-                }
-                for tenant, cell in sorted(sample.get("tenants", {}).items())
-            },
+            "tenants": tenants,
         })
     else:
         beat.update({"window_s": None, "rps": 0.0, "shed_rate": 0.0,

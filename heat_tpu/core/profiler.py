@@ -113,6 +113,8 @@ __all__ = [
     "current_request_tag",
     "current_deadline",
     "attributed",
+    "attribution_active",
+    "request_slices",
     "scope",
     "observe",
     "histogram_snapshots",
@@ -167,6 +169,14 @@ _current_deadline: "contextvars.ContextVar[Optional[float]]" = contextvars.Conte
     "heat_tpu_profiler_deadline", default=None
 )
 _deadline_seen: bool = False
+
+# Late-bound forensics module (set once at `heat_tpu.core.forensics` import,
+# read bare afterwards — the diagnostics tee pattern). While the forensics
+# plane is armed, `request()` runs "lite-active": it allocates a request id
+# and threads the contextvar (so tenant attribution and forensic records
+# work) even when the profiler itself is disabled, but records no slices and
+# observes no histograms. Forensics calls happen OUTSIDE `_lock`.
+_forensics = None
 
 # perf_counter origin for trace timestamps; rebased on enable() so a long-lived
 # process's trace starts near zero. Microseconds, Chrome's native unit.
@@ -414,6 +424,27 @@ def current_request() -> Optional[int]:
     return _current_request.get()
 
 
+def attribution_active() -> bool:
+    """True while request attribution is flowing: the profiler is enabled, or
+    the forensics plane is armed (its lifecycle records ride the same request
+    contextvar). Hot paths that only need a tenant/request id gate on this
+    instead of ``_active`` — still just relaxed attribute reads."""
+    f = _forensics
+    return _active or (f is not None and f._enabled)
+
+
+def request_slices(rid: int) -> List[dict]:
+    """Every recorded slice attributed to request ``rid``, as ``{cat, name,
+    t0_us, t1_us}`` dicts in recording order. Used by the forensics plane to
+    attach a span tree to a tail exemplar at capture time."""
+    with _lock:
+        return [
+            {"cat": c, "name": n, "t0_us": t0, "t1_us": t1}
+            for (r, _tid, c, n, t0, t1) in _slices
+            if r == rid
+        ]
+
+
 def current_request_tag() -> Optional[str]:
     """The ambient request's *tag* (the string passed to :func:`request`), or
     None outside a request scope / while disabled. The async executor uses
@@ -433,8 +464,9 @@ def attributed(req: Optional[int]):
     the block (no-op for ``None`` or while disabled). The dispatch scheduler
     wraps queued executions in this so program-call and collective slices
     running on the scheduler thread still attribute to the request that
-    planned the force."""
-    if req is None or not _active:
+    planned the force. Also threads while only the forensics plane is armed —
+    its records attribute through the same contextvar."""
+    if req is None or not attribution_active():
         yield
         return
     token = _current_request.set(req)
@@ -466,13 +498,20 @@ def request(tag: str, deadline_s: Optional[float] = None):
     that cannot meet it, and readers get a typed
     ``ht.resilience.DeadlineExceeded`` instead of late results. The deadline
     is a lifecycle contract, not telemetry: it is armed even while the
-    profiler is disabled."""
+    profiler is disabled.
+
+    While the forensics plane is armed the scope runs "lite-active" even with
+    the profiler disabled: a request id is allocated and threaded (so tenant
+    attribution and the lifecycle record work) and the forensic record is
+    opened/closed around the body, but no slices or histograms are recorded."""
     global _deadline_seen
     dtoken = None
     if deadline_s is not None:
         _deadline_seen = True
         dtoken = _current_deadline.set(time.monotonic() + float(deadline_s))
-    if not _active:
+    f = _forensics
+    fon = f is not None and f._enabled
+    if not _active and not fon:
         try:
             yield None
         finally:
@@ -486,6 +525,8 @@ def request(tag: str, deadline_s: Optional[float] = None):
         while len(_requests) > _MAX_REQUESTS:
             _requests.popitem(last=False)
     token = _current_request.set(rid)
+    if fon:
+        f.begin_request(rid, str(tag), _current_deadline.get())
     try:
         yield rid
     finally:
@@ -497,8 +538,11 @@ def request(tag: str, deadline_s: Optional[float] = None):
             entry = _requests.get(rid)
             if entry is not None:
                 entry["t1_us"] = t1
-            _slices.append((rid, threading.get_ident(), "request", str(tag), t0, t1))
-            _hist_locked(f"request.{tag}").observe((t1 - t0) / 1e6)
+            if _active:
+                _slices.append((rid, threading.get_ident(), "request", str(tag), t0, t1))
+                _hist_locked(f"request.{tag}").observe((t1 - t0) / 1e6)
+        if fon:
+            f.finish_request(rid, (t1 - t0) / 1e6)
 
 
 @contextlib.contextmanager

@@ -1230,10 +1230,18 @@ def _fold_ops_section(beats: Dict[str, dict]) -> dict:
     spans, so they land in disjoint report sections (``counters`` /
     ``executor`` vs ``ops``) and are never added together. One beat per rank
     (latest-wins files), so the cross-rank sums here are exact for the
-    beats' own windows."""
+    beats' own windows.
+
+    The per-tenant COST cells (``device_s`` / ``flops`` /
+    ``collective_bytes`` from the forensics meters) are the one cumulative
+    family riding the beats; they still obey the disjointness rule — they
+    fold into their own ``tenant_cost`` sub-section here (exact cross-rank
+    sums of per-rank cumulative meters), never into the windowed totals and
+    never into the shard ``counters`` section."""
     ranks = {r: beats[r] for r in sorted(beats, key=lambda x: (len(x), x))}
     totals = {"rps": 0.0, "shed_rate": 0.0, "queue_depth": 0}
     alerts = []
+    tenant_cost: Dict[str, Dict[str, float]] = {}
     for rank, beat in ranks.items():
         totals["rps"] += beat.get("rps") or 0.0
         totals["shed_rate"] += beat.get("shed_rate") or 0.0
@@ -1242,12 +1250,22 @@ def _fold_ops_section(beats: Dict[str, dict]) -> dict:
             if cell.get("alert"):
                 alerts.append({"rank": rank, "tenant": tenant,
                                "burn_1m": cell.get("burn_1m")})
+            if any(cell.get(k) for k in
+                   ("device_s", "flops", "collective_bytes")):
+                cost = tenant_cost.setdefault(
+                    tenant, {"device_s": 0.0, "flops": 0.0,
+                             "collective_bytes": 0.0})
+                cost["device_s"] += cell.get("device_s") or 0.0
+                cost["flops"] += cell.get("flops") or 0.0
+                cost["collective_bytes"] += cell.get("collective_bytes") or 0.0
     return {
         "schema": "heat-tpu-ops-merged/1",
         "ranks": ranks,
         "totals": {k: round(v, 6) if isinstance(v, float) else v
                    for k, v in totals.items()},
         "alerts": alerts,
+        "tenant_cost": {t: {k: round(v, 6) for k, v in c.items()}
+                        for t, c in sorted(tenant_cost.items())},
     }
 
 
@@ -1270,12 +1288,48 @@ def _render_top(ranks: Dict[str, dict]) -> str:
         for tenant, cell in sorted((beat.get("tenants") or {}).items()):
             p99 = cell.get("p99_ms")
             burn = cell.get("burn_1m")
+            dev = cell.get("device_s")
             lines.append(
                 f"      {tenant:<16} p99 "
                 f"{(f'{p99:.2f}ms' if p99 is not None else '-'):>10}  "
                 f"burn1m {(f'{burn:.2f}' if burn is not None else '-'):>6}  "
+                f"cost {(f'{dev:.3f}s' if dev else '-'):>9}  "
                 f"{'ALERT' if cell.get('alert') else 'ok'}")
     return "\n".join(lines)
+
+
+def _render_slow(shards: List[dict], tenant: Optional[str],
+                 limit: int) -> Tuple[int, str]:
+    """The ``telemetry slow`` view: the slowest forensic exemplars across a
+    directory of shards — each with its critical path, so "why was this
+    slow" is answerable from merged artifacts offline. Exemplars ride shard
+    dumps inside the ``diagnostics.forensics`` provider section (written
+    when the run was armed with ``HEAT_TPU_FORENSICS=1``)."""
+    rows: List[Tuple[Any, dict]] = []
+    for shard in shards:
+        rank = (shard.get("process") or {}).get("index", "?")
+        fx = (shard.get("diagnostics") or {}).get("forensics") or {}
+        for t, recs in (fx.get("exemplars") or {}).items():
+            if tenant is not None and t != tenant:
+                continue
+            rows.extend((rank, r) for r in recs)
+    if not rows:
+        return 1, ("no forensic exemplars in these shards — was the run "
+                   "armed with HEAT_TPU_FORENSICS=1?")
+    rows.sort(key=lambda pr: (-pr[1].get("total_s", 0.0),
+                              pr[1].get("rid", 0)))
+    lines = []
+    for rank, r in rows[:max(1, limit)]:
+        lines.append(
+            f"#{r.get('rid')} tenant={r.get('tenant')} rank={rank} "
+            f"total={r.get('total_s', 0.0) * 1e3:.2f}ms "
+            f"dominant={r.get('dominant')}")
+        path = " -> ".join(
+            f"{leg.get('stage')} {leg.get('share', 0.0) * 100:.0f}% "
+            f"({leg.get('seconds', 0.0) * 1e3:.2f}ms)"
+            for leg in r.get("critical_path") or [])
+        lines.append(f"    path: {path or '(empty)'}")
+    return 0, "\n".join(lines)
 
 
 def _top_once(directory: Optional[str]) -> Tuple[int, str]:
@@ -1311,7 +1365,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ``python -m heat_tpu.telemetry top [--dir D] [--watch N]`` — render the
     per-rank / per-tenant live operations table: from ``ops-beat-r*.json``
     files under ``--dir``, or (no ``--dir``) from the live cluster fold over
-    the jax.distributed coordination channel (``ops.cluster_snapshot``)."""
+    the jax.distributed coordination channel (``ops.cluster_snapshot``).
+
+    ``python -m heat_tpu.telemetry slow --dir D [--limit N] [--tenant T]``
+    — print the slowest forensic exemplars recorded in the shards under
+    ``D`` (dumped by a run armed with ``HEAT_TPU_FORENSICS=1``), each with
+    its per-stage critical path — the offline "why was this slow" view."""
     import argparse
 
     parser = argparse.ArgumentParser(prog="python -m heat_tpu.telemetry")
@@ -1341,7 +1400,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "coordination channel")
     tp.add_argument("--watch", type=float, default=None, metavar="SECONDS",
                     help="refresh every N seconds until interrupted")
+    sp = sub.add_parser("slow", help="print the slowest forensic exemplars "
+                        "from a directory of telemetry shards")
+    sp.add_argument("--dir", required=True,
+                    help="directory holding telemetry-shard-*.json")
+    sp.add_argument("--limit", type=int, default=10,
+                    help="show at most N exemplars (default 10)")
+    sp.add_argument("--tenant", default=None,
+                    help="only this tenant's exemplars")
     args = parser.parse_args(argv)
+
+    if args.cmd == "slow":
+        try:
+            shards = load_shards(args.dir)
+            if not shards:
+                raise ValueError(
+                    f"no {SHARD_PREFIX}*.json shards under {args.dir}")
+        except (ValueError, OSError, KeyError) as exc:
+            print(f"telemetry slow FAILED: {type(exc).__name__}: {exc}")
+            return 1
+        rc, text = _render_slow(shards, args.tenant, args.limit)
+        print(text)
+        return rc
 
     if args.cmd == "top":
         try:

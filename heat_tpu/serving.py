@@ -42,8 +42,8 @@ from typing import Any, Optional
 
 from .core import checkpoint as _checkpoint
 from .core import (
-    _result_cache, diagnostics, ops, profiler, resilience, supervision,
-    telemetry,
+    _result_cache, diagnostics, forensics, ops, profiler, resilience,
+    supervision, telemetry,
 )
 from .core.resilience import SwapFailed
 
@@ -206,6 +206,15 @@ class ModelPool:
         """The declared objectives with their latest burn rates and alert
         states (:func:`heat_tpu.core.ops.slo_status`)."""
         return ops.slo_status()
+
+    def explain(self, tenant: Optional[str] = None, limit: int = 5) -> dict:
+        """Answer "why was this slow" for ``tenant``'s serving traffic (or
+        all of it) from the request-forensics artifact
+        (:func:`heat_tpu.core.forensics.explain`): dominant-stage
+        distribution, cost meters, and the slowest exemplars with their
+        critical paths. Needs the plane armed (``HEAT_TPU_FORENSICS=1``) —
+        idle it returns an empty artifact, it never raises."""
+        return forensics.explain(tenant, limit=limit)
 
     @staticmethod
     def _forget_failed_peer(exc: BaseException) -> None:
