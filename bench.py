@@ -331,6 +331,46 @@ def _bench_dispatch(devices: int = 8, timeout_s: float = 900.0) -> list:
     return records
 
 
+def _bench_collective_matmul(timeout_s: float = 900.0) -> list:
+    """Communication-optimal linalg gate (``benchmarks/cb/collective_matmul.py``)
+    at 3 AND 8 virtual devices in hermetic CPU-mesh subprocesses: modeled
+    wire-byte ratios (ring vs gathered baseline, all_to_all resplit vs gather
+    path), compiled per-device ring memory, bit parity vs the XLA-default
+    plan, and wall-time throughput vs the committed lower envelope — host-side
+    only, so the planner's trajectory records every round even relay-down."""
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    script = os.path.join(here, "benchmarks", "cb", "collective_matmul.py")
+    baseline = os.path.join(here, "benchmarks", "cb", "collective_matmul_baseline.json")
+    records = []
+    for devices in (3, 8):
+        proc = subprocess.run(
+            [sys.executable, script, "--devices", str(devices),
+             "--check", "--baseline", baseline],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        found = False
+        for line in proc.stdout.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                records.append(rec)
+                found = True
+        if not found or proc.returncode != 0:
+            raise RuntimeError(
+                f"collective_matmul gate failed at {devices} devices "
+                f"(rc={proc.returncode}): {proc.stderr[-500:]}"
+            )
+    return records
+
+
 def _bench_checkpoint(devices: int = 8, timeout_s: float = 900.0) -> list:
     """Checkpoint save/restore GB/s (``benchmarks/cb/checkpoint_bw.py``) in a
     hermetic virtual CPU mesh subprocess: v1 single-writer vs v2 parallel
@@ -812,6 +852,10 @@ def main():
         traceback.print_exc(file=sys.stderr)
     try:
         dispatch_extras += _bench_checkpoint()
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    try:
+        dispatch_extras += _bench_collective_matmul()
     except Exception:
         traceback.print_exc(file=sys.stderr)
     try:

@@ -23,6 +23,7 @@ import benchmarks.cb.attention  # noqa: F401,E402
 import benchmarks.cb.collectives  # noqa: F401,E402
 import benchmarks.cb.optimizer  # noqa: F401,E402
 import benchmarks.cb.dispatch  # noqa: F401,E402
+import benchmarks.cb.collective_matmul  # noqa: F401,E402
 
 if __name__ == "__main__":
     failed = run_all(filter_substring=os.environ.get("HEAT_TPU_BENCH_FILTER"))

@@ -153,6 +153,26 @@ CONTRACTS: Dict[str, dict] = {
         "origin": "linalg wrap helper: comm.shard(value, split) immediately "
                   "above the construction",
     },
+    # ------------------------------------------------------------ comm planner
+    "heat_tpu.core.linalg.comm_plan:_execute": {
+        "result_split": ["out_split"],
+        "pads": "handled",
+        "origin": "comm_plan._execute docstring: the staged ring/rs program "
+                  "is laid out by its own out_shardings (comm.sharding(2, "
+                  "out_split), the same out_split the construction claims); "
+                  "pad slots stay zero inside the traced body — zero input "
+                  "pads contribute zero partial products (ring) or zero "
+                  "psum_scatter rows (rs), and rC trims its padded "
+                  "accumulator before returning",
+    },
+    "heat_tpu.core.linalg.comm_plan:try_resplit": {
+        "returns": "padded-physical",
+        "origin": "try_resplit docstring: returns the raw padded-physical "
+                  "jax.Array for split=axis (dst dim zero-padded before the "
+                  "all_to_all, old split's pads trimmed in-program) — the "
+                  "only caller, DNDarray._reshard, binds it as the physical "
+                  "value for exactly that (gshape, split)",
+    },
 }
 
 

@@ -450,11 +450,16 @@ def _resolve_op(name: str):
 
 
 def _replay_staged(spec: dict) -> bool:
-    """Re-dispatch one staged ``l``/``r``/``c`` signature through its real
-    wrapper over a zeros array of the recorded layout — the executor's table
-    ends up keyed exactly as live traffic keys it."""
+    """Re-dispatch one staged ``l``/``r``/``c``/``mm`` signature through its
+    real wrapper over a zeros array of the recorded layout — the executor's
+    table ends up keyed exactly as live traffic keys it."""
     from . import _operations
 
+    if spec["family"] == "mm":
+        # comm-plan contraction / resplit programs (linalg/comm_plan.py)
+        from .linalg import comm_plan
+
+        return comm_plan.replay_warmup(spec, _zeros_dnd)
     op = _resolve_op(spec["op"])
     x = _zeros_dnd(spec["gshape"], spec["split"], spec["dtype"])
     if list(x.parray.shape) != list(spec["phys"]):

@@ -322,7 +322,7 @@ class _EnvKnobs:
     __slots__ = (
         "eager_dispatch", "async_dispatch", "jit_threshold",
         "queue_bound", "batch_max", "quarantine_after", "shed",
-        "sched_shards", "batch_window_s", "exec_cache",
+        "sched_shards", "batch_window_s", "exec_cache", "linalg_plan",
     )
 
     def reload(self) -> None:
@@ -353,6 +353,9 @@ class _EnvKnobs:
             self.batch_window_s = 0.0
         # persistent per-signature compile-cache directory (None = off)
         self.exec_cache = os.environ.get("HEAT_TPU_EXEC_CACHE") or None
+        # communication plan for distributed contractions (linalg/comm_plan.py)
+        plan = os.environ.get("HEAT_TPU_LINALG_PLAN", "auto").strip().lower()
+        self.linalg_plan = plan if plan in ("auto", "xla", "ring", "rs") else "auto"
 
 
 _knobs = _EnvKnobs()
@@ -378,7 +381,9 @@ def reload_env_knobs() -> None:
     here as well — see :mod:`._result_cache`. The live-operations knobs
     (``HEAT_TPU_OPS*``) re-read here too — see :mod:`.ops` — as do the
     request-forensics knobs (``HEAT_TPU_FORENSICS*``) — see
-    :mod:`.forensics`."""
+    :mod:`.forensics`. The communication-plan knob for distributed
+    contractions (``HEAT_TPU_LINALG_PLAN``) re-reads here too — see
+    :func:`linalg_plan` and :mod:`.linalg.comm_plan`."""
     _knobs.reload()
     supervision.reload_env_knobs()
     _compile_cache.reload()
@@ -398,6 +403,18 @@ def jit_threshold() -> int:
     never replay. Memoised; see :func:`reload_env_knobs` for the re-read
     contract."""
     return _knobs.jit_threshold
+
+
+def linalg_plan() -> str:
+    """The communication plan for distributed contractions
+    (``HEAT_TPU_LINALG_PLAN``): ``auto`` (default — the cost model in
+    :mod:`.linalg.comm_plan` picks per call), ``xla`` (always the XLA-SPMD
+    default, also disabling the all_to_all resplit path), ``ring`` (force the
+    ring collective matmul where eligible), or ``rs`` (force the
+    reduce-scatter contraction — note this changes the result's split from
+    ``None`` to ``0``). Unknown values fall back to ``auto``. Memoised; see
+    :func:`reload_env_knobs` for the re-read contract."""
+    return _knobs.linalg_plan
 
 
 _single_controller: Optional[bool] = None

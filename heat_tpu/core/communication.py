@@ -147,6 +147,7 @@ __all__ = [
     "use_comm",
     "sanitize_comm",
     "initialize",
+    "compat_shard_map",
 ]
 
 # The default mesh axis name carried by every split DNDarray dimension.
@@ -165,6 +166,32 @@ def _payload_bytes(x) -> int:
     for s in shape:
         size *= int(s)
     return size * np.dtype(dtype).itemsize
+
+
+try:  # jax >= 0.6: top-level export, replication check spelled check_vma=
+    _shard_map_impl = jax.shard_map
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except AttributeError:  # pragma: no cover - jax 0.4.x: experimental home, check_rep=
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _SHARD_MAP_CHECK_KW = "check_rep"
+
+
+def compat_shard_map(fn, mesh, in_specs, out_specs, check: bool = False):
+    """``shard_map`` across the jax versions this repo supports.
+
+    ``jax.shard_map`` only exists from jax 0.6 (with the replication check
+    spelled ``check_vma=``); on 0.4.x the implementation lives in
+    ``jax.experimental.shard_map`` and the same switch is ``check_rep=``.
+    Explicit-collective program bodies (the comm-plan ring/reduce-scatter
+    matmuls, the all_to_all resplit) go through this resolver so one spelling
+    traces on both. ``check=False`` (the default) also sidesteps the 0.4.x
+    requirement to ``pcast`` replicated outputs, which has no stable spelling
+    across versions."""
+    return _shard_map_impl(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_SHARD_MAP_CHECK_KW: check},
+    )
 
 
 class Communication:
@@ -500,6 +527,24 @@ class MeshCommunication(Communication):
         )
 
     Allgather = all_gather
+
+    def psum_scatter(
+        self, x, scatter_axis: int = 0, axis_name: Optional[str] = None, tiled: bool = True,
+    ):
+        """Reduce-scatter (reference ``Reduce_scatter`` / ``__reduce_like`` with a
+        scattered result): sums ``x`` across the axis and leaves each participant
+        only its 1/P tile along array axis ``scatter_axis`` — the (P−1)/P-byte
+        half of an all-reduce, for consumers that keep the result sharded (the
+        comm-plan ``rs`` contraction plan)."""
+        if diagnostics._enabled or forensics._enabled:
+            self._record_collective("psum_scatter", axis_name, x)
+        return _guarded(
+            "comm.psum_scatter", jax.lax.psum_scatter,
+            x, axis_name or self.axis_name, scatter_dimension=scatter_axis,
+            tiled=tiled,
+        )
+
+    Reduce_scatter = psum_scatter
 
     def all_to_all(self, x, split_axis: int, concat_axis: int, axis_name: Optional[str] = None):
         """Alltoall (reference ``__alltoall_like`` ``communication.py:1236``)."""

@@ -450,12 +450,22 @@ class DNDarray:
         return self
 
     def _reshard(self, axis: Optional[int]) -> jax.Array:
-        """The physical value laid out for ``axis``. A ragged source resplits
-        padded-value-first: the all-to-all moves O(n/P) buffers and the old
-        padding is trimmed afterwards on the now-unsharded dim (a shard-local
-        slice) — the logical (replicated) trim never materialises. ``axis=None``
-        replicates by definition, so it takes the plain path; the unpadded path
-        is one re-sharding as before."""
+        """The physical value laid out for ``axis``. split→split goes through
+        the comm planner's ``all_to_all`` program when eligible — each device
+        exchanges only the (P−1)/P of its shard the peers need, never a
+        gathered copy (``linalg/comm_plan.py``; disabled along with the rest
+        of the planner by ``HEAT_TPU_LINALG_PLAN=xla``). Otherwise a ragged
+        source resplits padded-value-first: the all-to-all moves O(n/P)
+        buffers and the old padding is trimmed afterwards on the now-unsharded
+        dim (a shard-local slice) — the logical (replicated) trim never
+        materialises. ``axis=None`` replicates by definition, so it takes the
+        plain path; the unpadded path is one re-sharding as before."""
+        if axis is not None and self.__split is not None and axis != self.__split:
+            from .linalg import comm_plan
+
+            moved = comm_plan.try_resplit(self, axis)
+            if moved is not NotImplemented:
+                return moved
         if self._is_padded() and axis is not None and axis != self.__split:
             moved = self.__comm.shard(self.parray, axis)
             sl = tuple(

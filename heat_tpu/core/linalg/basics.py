@@ -21,6 +21,7 @@ from .. import _operations, factories, sanitation, types
 from ..communication import get_comm
 from ..dndarray import DNDarray
 from ..stride_tricks import sanitize_axis
+from . import comm_plan
 
 __all__ = [
     "PARITY_PRECISION",
@@ -85,8 +86,15 @@ def matmul(
 
     Output split rule: a row-split ``a`` yields a row-split product; a column-split ``b``
     yields a column-split product; contraction-dim splits all-reduce away to ``None``;
-    batch-dim splits are preserved. The data movement itself is XLA SPMD's choice
-    (typically all-gather of the smaller panel riding ICI).
+    batch-dim splits are preserved (``HEAT_TPU_LINALG_PLAN=rs`` opts contraction-dim
+    splits into a reduce-scatter with a ``split=0`` product instead).
+
+    The data movement is chosen per call by the communication planner
+    (:mod:`.comm_plan`): 2-D both-split pairs take the ring collective matmul
+    (one panel in flight over ``ppermute``, the gathered operand never
+    materialised); everything else defers to XLA SPMD's default (typically
+    all-gather of the smaller panel riding ICI). ``HEAT_TPU_LINALG_PLAN``
+    forces a plan; the choice is recorded as ``linalg.plan.*`` diagnostics.
 
     ``precision`` passes through to ``jnp.matmul`` — ``None`` picks a dtype-aware
     default (:func:`_contraction_precision`): full-f32 passes for float32 operands,
@@ -95,6 +103,9 @@ def matmul(
     sanitation.sanitize_in(a)
     sanitation.sanitize_in(b)
     precision = _contraction_precision(precision, a, b)
+    planned = comm_plan.try_matmul(a, b, precision)
+    if planned is not NotImplemented:
+        return planned
     result = jnp.matmul(a.larray, b.larray, precision=precision)
     nd_out = result.ndim
     # position of a's row dim / b's col dim in the output (absent for 1-D operands)
@@ -125,15 +136,9 @@ def dot(
     if a.ndim == 1 and b.ndim == 1:
         result = jnp.dot(a.larray, b.larray, precision=_contraction_precision(precision, a, b))
         res = _wrap_like(result, a, None)
-        if out is not None:
-            out.larray = res.larray
-            return out
-        return res
+        return _operations.handle_out(res, out, a)
     ret = matmul(a, b, precision=precision)
-    if out is not None:
-        out.larray = ret.larray
-        return out
-    return ret
+    return _operations.handle_out(ret, out, a)
 
 
 def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdims: bool = False) -> DNDarray:
@@ -161,10 +166,7 @@ def outer(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None, split: Optio
     if split is None:
         split = 0 if a.split is not None else (1 if b.split is not None else None)
     res = _wrap_like(result, a, split)
-    if out is not None:
-        out.larray = res.larray
-        return out
-    return res
+    return _operations.handle_out(res, out, a)
 
 
 def cross(
